@@ -1,0 +1,20 @@
+"""Test env: force CPU with 8 virtual devices so mesh tests simulate a
+v5e-8 slice (SURVEY.md §4 test strategy).
+
+Note: the axon TPU plugin registers itself via sitecustomize and
+overrides JAX_PLATFORMS, so the env var alone is not enough — we must
+also update jax's config after import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,68 @@
+"""Golden transcripts for the chapter-1 threshold job
+(reference chapter1/README.md:72-84 and :114-123)."""
+
+import numpy as np
+
+from tpustream import StreamExecutionEnvironment, Tuple3
+from tpustream.config import StreamConfig
+from tpustream.jobs.chapter1_threshold import build, parse
+from tpustream.runtime.sources import ReplaySource
+
+
+def run_filter_job(lines, **cfg):
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(lines))
+    handle = build(env, text).collect()
+    env.execute("Window WordCount")
+    return handle.items
+
+
+def test_filter_gt90_golden():
+    # chapter1/README.md:114-123: only the 99.2 record survives
+    out = run_filter_job(
+        [
+            "1563452051 10.8.22.1 cpu2 10.5",
+            "1563452051 10.8.22.1 cpu2 99.2",
+        ]
+    )
+    assert out == [("10.8.22.1", "cpu2", 99.2)]
+    assert repr(out[0]) == "(10.8.22.1,cpu2,99.2)"
+
+
+def test_passthrough_map_golden(capsys):
+    # chapter1/README.md:72-84: map+print with no filter
+    env = StreamExecutionEnvironment(StreamConfig(print_parallelism=4))
+    text = env.add_source(
+        ReplaySource(
+            [
+                "1563452056 10.8.22.1 cpu0 80.5",
+                "1563452051 10.8.22.1 cpu2 10.5",
+                "1563452051 10.8.22.1 cpu2 10.5",
+            ]
+        )
+    )
+    text.map(parse).print()
+    env.execute("Window WordCount")
+    lines = capsys.readouterr().out.strip().splitlines()
+    # subtask prefixes are scheduler-dependent in Flink; assert form + payload
+    payloads = [l.split("> ", 1)[1] for l in lines]
+    assert payloads == [
+        "(10.8.22.1,cpu0,80.5)",
+        "(10.8.22.1,cpu2,10.5)",
+        "(10.8.22.1,cpu2,10.5)",
+    ]
+    for l in lines:
+        assert l[0] in "1234" and l[1:3] == "> "
+
+
+def test_small_batches_equivalent():
+    lines = [f"1563452051 10.8.22.{i%4} cpu{i%3} {50 + (i % 60)}.5" for i in range(100)]
+    big = run_filter_job(lines)
+    small = run_filter_job(lines, batch_size=7)
+    assert big == small
+    expected = [
+        (f"10.8.22.{i%4}", f"cpu{i%3}", 50 + (i % 60) + 0.5)
+        for i in range(100)
+        if 50 + (i % 60) + 0.5 > 90
+    ]
+    assert big == expected

@@ -1,0 +1,103 @@
+"""Golden transcript for the chapter-2 rolling max
+(reference chapter2/README.md:52-66) plus semantics edge cases."""
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.config import StreamConfig
+from tpustream.jobs.chapter2_max import build
+from tpustream.runtime.sources import ReplaySource
+
+
+def run(lines, **cfg):
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(lines))
+    handle = build(env, text).collect()
+    env.execute("ComputeCpuMax")
+    return handle.items
+
+
+def test_rolling_max_golden():
+    out = run(
+        [
+            "1563452056 10.8.22.1 cpu0 80.5",
+            "1563452050 10.8.22.1 cpu0 78.4",
+            "1563452056 10.8.22.1 cpu0 99.9",
+        ]
+    )
+    assert [repr(t) for t in out] == [
+        "(10.8.22.1,cpu0,80.5)",
+        "(10.8.22.1,cpu0,80.5)",
+        "(10.8.22.1,cpu0,99.9)",
+    ]
+
+
+def test_rolling_max_keeps_first_seen_fields():
+    # Flink max(pos) keeps NON-aggregated fields from the key's first record
+    out = run(
+        [
+            "1 10.8.22.1 cpu0 50.0",
+            "2 10.8.22.1 cpu7 60.0",   # higher usage but cpu field stays cpu0
+            "3 10.8.22.1 cpu3 55.0",
+        ]
+    )
+    assert [repr(t) for t in out] == [
+        "(10.8.22.1,cpu0,50.0)",
+        "(10.8.22.1,cpu0,60.0)",
+        "(10.8.22.1,cpu0,60.0)",
+    ]
+
+
+def test_rolling_max_multi_key_and_batches():
+    lines = []
+    expected = {}
+    rows = []
+    vals = [(("h%d" % (i % 3)), 10.0 + ((i * 7) % 50)) for i in range(60)]
+    for i, (h, v) in enumerate(vals):
+        lines.append(f"{i} {h} cpu{i%2} {v}")
+    # emulate semantics in python
+    state = {}
+    for i, (h, v) in enumerate(vals):
+        if h not in state:
+            state[h] = [h, f"cpu{i%2}", v]
+        else:
+            state[h][2] = max(state[h][2], v)
+        rows.append(tuple(state[h]))
+    out_big = run(lines)
+    out_small = run(lines, batch_size=7)
+    assert [t.values() for t in out_big] == rows
+    assert out_big == out_small
+
+
+def test_rolling_min_and_sum():
+    lines = ["1 h1 c 5.0", "2 h1 c 3.0", "3 h1 c 4.0"]
+
+    def run_kind(kind):
+        from tpustream.jobs.chapter2_max import parse
+
+        env = StreamExecutionEnvironment(StreamConfig())
+        s = env.add_source(ReplaySource(lines)).map(parse).key_by(0)
+        h = getattr(s, kind)(2).collect()
+        env.execute("k")
+        return [t.f2 for t in h.items]
+
+    assert run_kind("min") == [5.0, 3.0, 3.0]
+    assert run_kind("sum") == [5.0, 8.0, 12.0]
+
+
+def test_rolling_max_by_replaces_whole_record():
+    lines = ["1 h1 cpu0 50.0", "2 h1 cpu7 60.0", "3 h1 cpu3 55.0"]
+    from tpustream.jobs.chapter2_max import parse
+
+    env = StreamExecutionEnvironment(StreamConfig())
+    h = (
+        env.add_source(ReplaySource(lines))
+        .map(parse)
+        .key_by(0)
+        .max_by(2)
+        .collect()
+    )
+    env.execute("k")
+    assert [repr(t) for t in h.items] == [
+        "(h1,cpu0,50.0)",
+        "(h1,cpu7,60.0)",
+        "(h1,cpu7,60.0)",
+    ]

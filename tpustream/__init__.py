@@ -1,0 +1,67 @@
+"""tpustream — a TPU-native streaming monitoring/alerting framework.
+
+Provides the dataflow surface of the reference Flink DataStream tutorial
+(`/root/reference`, Jax-Rene/monitor-systam-flink-quickstart) — lazy job
+graphs, map/filter/keyBy, rolling aggregates, tumbling/sliding/session
+time windows, reduce/aggregate/process window functions, processing- and
+event-time with bounded-out-of-orderness watermarks, allowed lateness and
+late-data side outputs, parallel print sinks — executed not by a JVM
+record-at-a-time runtime but as micro-batched SPMD XLA computations:
+
+  * keyed state lives in dense TPU-HBM arrays indexed by interned key ids,
+  * ``keyBy`` is an ICI ``all_to_all`` under ``shard_map`` over a device mesh,
+  * sliding windows are pane-ring accumulators composed by an MXU matmul,
+  * the event-time clock is a device-carried watermark scalar implementing
+    the monotone ``max_seen_ts - delay`` contract of Flink's
+    BoundedOutOfOrdernessTimestampExtractor
+    (reference: chapter3/README.md:380-396).
+
+Double precision is enabled globally so windowed aggregates reproduce the
+reference's Java ``double`` golden outputs bit-for-bit (e.g.
+``86.26666666666667`` in chapter2/README.md:162).
+"""
+
+import jax as _jax
+
+# Java doubles / epoch-millisecond int64 timestamps need x64. TPU benchmark
+# configs opt back into f32/i32 accumulators via StreamConfig.
+_jax.config.update("jax_enable_x64", True)
+
+from .api.tuples import Tuple2, Tuple3, Tuple4  # noqa: E402
+from .api.timeapi import Time, TimeCharacteristic  # noqa: E402
+from .api.environment import StreamExecutionEnvironment  # noqa: E402
+from .api.watermarks import (  # noqa: E402
+    AssignerWithPeriodicWatermarks,
+    BoundedOutOfOrdernessTimestampExtractor,
+    Watermark,
+)
+from .api.functions import (  # noqa: E402
+    AggregateFunction,
+    FilterFunction,
+    MapFunction,
+    ProcessWindowFunction,
+    ReduceFunction,
+)
+from .api.output import OutputTag  # noqa: E402
+from .config import StreamConfig  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AggregateFunction",
+    "AssignerWithPeriodicWatermarks",
+    "BoundedOutOfOrdernessTimestampExtractor",
+    "FilterFunction",
+    "MapFunction",
+    "OutputTag",
+    "ProcessWindowFunction",
+    "ReduceFunction",
+    "StreamConfig",
+    "StreamExecutionEnvironment",
+    "Time",
+    "TimeCharacteristic",
+    "Tuple2",
+    "Tuple3",
+    "Tuple4",
+    "Watermark",
+]

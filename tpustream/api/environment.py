@@ -1,0 +1,98 @@
+"""StreamExecutionEnvironment — the job entry point.
+
+Mirrors the reference's phase-A/phase-B shape
+(chapter1/README.md:57-61): operator calls build a lazy graph;
+``execute(job_name)`` plans it, compiles one jitted XLA step program, and
+streams batches through it until the source is exhausted.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..config import StreamConfig
+from .datastream import DataStream
+from .graph import Node
+from .timeapi import TimeCharacteristic
+
+
+class StreamExecutionEnvironment:
+    def __init__(self, config: Optional[StreamConfig] = None):
+        self.config = config or StreamConfig()
+        self.time_characteristic = TimeCharacteristic.ProcessingTime
+        self._sinks: list[Node] = []
+        self.job_name: Optional[str] = None
+        self.metrics = None        # populated by execute()
+        self._checkpoint_restore_path: Optional[str] = None
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def get_execution_environment(
+        config: Optional[StreamConfig] = None,
+    ) -> "StreamExecutionEnvironment":
+        return StreamExecutionEnvironment(config)
+
+    getExecutionEnvironment = get_execution_environment
+
+    # -- configuration -------------------------------------------------------
+    def set_stream_time_characteristic(self, tc: TimeCharacteristic) -> None:
+        self.time_characteristic = tc
+
+    setStreamTimeCharacteristic = set_stream_time_characteristic
+
+    def set_parallelism(self, n: int) -> None:
+        self.config = self.config.replace(parallelism=n)
+
+    setParallelism = set_parallelism
+
+    def enable_checkpointing(
+        self, interval_batches: int, directory: Optional[str] = None
+    ) -> None:
+        self.config = self.config.replace(
+            checkpoint_interval_batches=interval_batches,
+            checkpoint_dir=directory or self.config.checkpoint_dir,
+        )
+
+    enableCheckpointing = enable_checkpointing
+
+    def restore_from_checkpoint(self, path: str) -> None:
+        self._checkpoint_restore_path = path
+
+    # -- sources -------------------------------------------------------------
+    def socket_text_stream(self, host: str, port: int) -> DataStream:
+        """nc-compatible line source (reference chapter1/.../Main.java:17,
+        run with ``nc -lk 8080`` per chapter1/README.md:65-68)."""
+        from ..runtime.sources import SocketTextSource
+
+        return self.add_source(SocketTextSource(host, port))
+
+    socketTextStream = socket_text_stream
+
+    def from_collection(self, lines: Iterable) -> DataStream:
+        from ..runtime.sources import ReplaySource
+
+        return self.add_source(ReplaySource(list(lines)))
+
+    fromCollection = from_collection
+
+    def add_source(self, source) -> DataStream:
+        node = Node("source", None, {"source": source})
+        return DataStream(self, node)
+
+    addSource = add_source
+
+    # -- execution -----------------------------------------------------------
+    def _register_sink(self, node: Node) -> None:
+        self._sinks.append(node)
+
+    def execute(self, job_name: str = "tpustream job"):
+        """Phase B: plan, compile, and run the job to source exhaustion.
+
+        Returns the executor's JobResult (collected metrics etc.).
+        """
+        from ..runtime.executor import execute_job
+
+        self.job_name = job_name
+        if not self._sinks:
+            raise RuntimeError("no sinks registered; nothing to execute")
+        return execute_job(self, self._sinks)

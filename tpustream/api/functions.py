@@ -1,0 +1,121 @@
+"""User-function base classes mirroring the reference's Flink API surface.
+
+The reference jobs implement these as anonymous inner classes
+(MapFunction at chapter1/.../Main.java:18-26, FilterFunction at :27-33,
+AggregateFunction at chapter2/.../ComputeCpuAvg.java:31-59,
+ProcessWindowFunction at chapter2/.../ComputeCpuMiddle.java:34-49,
+ReduceFunction at chapter3/.../BandwidthMonitor.java:37). Plain Python
+callables are accepted anywhere a function object is, so lambdas work as
+they do with Flink's SAM interfaces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Generic, Iterable, TypeVar
+
+IN = TypeVar("IN")
+OUT = TypeVar("OUT")
+ACC = TypeVar("ACC")
+KEY = TypeVar("KEY")
+
+
+class MapFunction(Generic[IN, OUT]):
+    def map(self, value: IN) -> OUT:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class FilterFunction(Generic[IN]):
+    def filter(self, value: IN) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ReduceFunction(Generic[IN]):
+    def reduce(self, a: IN, b: IN) -> IN:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class AggregateFunction(Generic[IN, ACC, OUT]):
+    """Incremental aggregation contract (create/add/get_result/merge).
+
+    Matches chapter2/.../ComputeCpuAvg.java:31-59. The TPU runtime
+    parallelizes by lifting each record to a one-element accumulator
+    ``add(value, create_accumulator())`` and combining with ``merge`` —
+    so, as with Flink's session-window and batched execution paths,
+    ``merge`` must be associative and consistent with repeated ``add``.
+    (``merge`` here actually runs on every batch — unlike the tumbling
+    single-threaded Flink path where it never fires,
+    chapter2/README.md:144-147.)
+    """
+
+    def create_accumulator(self) -> ACC:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def add(self, value: IN, accumulator: ACC) -> ACC:  # pragma: no cover
+        raise NotImplementedError
+
+    def get_result(self, accumulator: ACC) -> OUT:  # pragma: no cover
+        raise NotImplementedError
+
+    def merge(self, a: ACC, b: ACC) -> ACC:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # camelCase aliases so ports of reference code read naturally
+    createAccumulator = create_accumulator
+    getResult = get_result
+
+
+class WindowContext:
+    """Window metadata handed to ProcessWindowFunction.process.
+
+    Mirrors the ``Context`` described at chapter2/README.md:177-196:
+    window start/end plus the firing watermark.
+    """
+
+    def __init__(self, start: int, end: int, watermark: int):
+        self.window = self
+        self.start = start
+        self.end = end
+        self.current_watermark = watermark
+
+    def max_timestamp(self) -> int:
+        return self.end - 1
+
+
+class Collector(Generic[OUT]):
+    """Accumulates ``collect`` calls from user functions."""
+
+    def __init__(self) -> None:
+        self.items: list = []
+
+    def collect(self, value: OUT) -> None:
+        self.items.append(value)
+
+
+class ProcessWindowFunction(Generic[IN, OUT, KEY]):
+    """Full-window function (chapter2/.../ComputeCpuMiddle.java:34-49).
+
+    Runs on the host at window fire with the buffered window elements —
+    the deliberately non-incremental path (chapter2/README.md:231 warns it
+    is the slow one, and it is here too: elements round-trip from device
+    pane buffers).
+    """
+
+    def process(
+        self,
+        key: KEY,
+        context: WindowContext,
+        elements: Iterable[IN],
+        out: Collector,
+    ) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def as_callable(fn: Any, method: str) -> Callable:
+    """Return the callable for a user function: SAM object or plain callable."""
+    if hasattr(fn, method):
+        bound = getattr(fn, method)
+        if callable(bound):
+            return bound
+    if callable(fn):
+        return fn
+    raise TypeError(f"expected a callable or an object with .{method}(), got {fn!r}")

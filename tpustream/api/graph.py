@@ -1,0 +1,35 @@
+"""Lazy dataflow graph nodes.
+
+Mirrors Flink's deferred graph construction: operator calls only append
+nodes; nothing runs until ``env.execute(name)`` submits the graph
+(semantics documented at reference chapter1/README.md:57-61).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+_ids = itertools.count()
+
+
+@dataclass
+class Node:
+    op: str
+    parent: Optional["Node"] = None
+    params: dict = field(default_factory=dict)
+    nid: int = field(default_factory=lambda: next(_ids))
+
+    def chain_to_source(self) -> list:
+        """Nodes from source to self, inclusive."""
+        out = []
+        n: Optional[Node] = self
+        while n is not None:
+            out.append(n)
+            n = n.parent
+        out.reverse()
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Node#{self.nid}({self.op})"

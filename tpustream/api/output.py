@@ -1,0 +1,17 @@
+"""Side-output tags for late data (reference chapter3/README.md:216-228)."""
+
+from __future__ import annotations
+
+
+class OutputTag:
+    def __init__(self, tag_id: str):
+        self.id = tag_id
+
+    def __repr__(self) -> str:
+        return f"OutputTag({self.id!r})"
+
+    def __hash__(self) -> int:
+        return hash(("OutputTag", self.id))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, OutputTag) and other.id == self.id

@@ -1,0 +1,54 @@
+"""Time durations and stream time characteristics.
+
+Mirrors the reference surface: ``Time.minutes(1)`` window sizes
+(chapter2/.../ComputeCpuAvg.java:29), ``Time.seconds(5)`` slides
+(chapter3/.../BandwidthMonitorWithEventTime.java:46), and
+``TimeCharacteristic.{ProcessingTime, EventTime, IngestionTime}``
+(chapter3/.../BandwidthMonitor.java:22 /
+BandwidthMonitorWithEventTime.java:27; IngestionTime described at
+chapter3/README.md:91-95). All times are millisecond int64 internally.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Time:
+    """A duration in milliseconds."""
+
+    millis: int
+
+    @staticmethod
+    def milliseconds(n: int) -> "Time":
+        return Time(int(n))
+
+    @staticmethod
+    def seconds(n: int) -> "Time":
+        return Time(int(n) * 1000)
+
+    @staticmethod
+    def minutes(n: int) -> "Time":
+        return Time(int(n) * 60_000)
+
+    @staticmethod
+    def hours(n: int) -> "Time":
+        return Time(int(n) * 3_600_000)
+
+    @staticmethod
+    def days(n: int) -> "Time":
+        return Time(int(n) * 86_400_000)
+
+    def to_milliseconds(self) -> int:
+        return self.millis
+
+    def __int__(self) -> int:
+        return self.millis
+
+
+class TimeCharacteristic(enum.Enum):
+    ProcessingTime = "processing"
+    IngestionTime = "ingestion"
+    EventTime = "event"

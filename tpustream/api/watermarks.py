@@ -1,0 +1,89 @@
+"""Timestamp assignment and watermark generation.
+
+Implements the contract the reference documents in full source at
+chapter3/README.md:310-398: a periodic assigner whose watermark is
+``max_seen_timestamp - max_out_of_orderness``, never moving backwards.
+On the TPU runtime the watermark is a device-carried int64 scalar updated
+per batch (a masked ``max`` then a monotone clamp), so window firing is a
+pure function of the data — replayable, as chapter3/README.md:408 demands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from .timeapi import Time
+
+LONG_MIN = -(2**63)
+# Watermark value emitted at end of a bounded event-time stream: fires every
+# remaining window, like Flink's Long.MAX_VALUE watermark on source close.
+MAX_WATERMARK = 2**62
+
+
+@dataclass(frozen=True)
+class Watermark:
+    timestamp: int
+
+
+class TimestampAssigner:
+    """Base: extract an epoch-millisecond event timestamp from an element."""
+
+    def extract_timestamp(self, element: Any) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+    # camelCase alias for reference-style code
+    def extractTimestamp(self, element: Any) -> int:
+        return self.extract_timestamp(element)
+
+
+class AssignerWithPeriodicWatermarks(TimestampAssigner):
+    def get_current_watermark(self) -> Watermark:  # pragma: no cover
+        raise NotImplementedError
+
+
+class AssignerWithPunctuatedWatermarks(TimestampAssigner):
+    """Data-driven watermark assigner (chapter3/README.md:400).
+
+    ``check_and_get_next_watermark`` is consulted per element; the runtime
+    folds the per-batch maximum of returned watermarks into the clock.
+    """
+
+    def check_and_get_next_watermark(
+        self, element: Any, extracted_timestamp: int
+    ) -> Watermark | None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class BoundedOutOfOrdernessTimestampExtractor(AssignerWithPeriodicWatermarks):
+    """Fixed-lag watermarking (chapter3/README.md:342-397 reproduces the
+    algorithm; used at chapter3/.../BandwidthMonitorWithEventTime.java:30-35).
+
+    Subclasses implement ``extract_timestamp``. The host keeps the scalar
+    bookkeeping for API parity; the authoritative copy of
+    ``max_seen - delay`` monotone clamping runs inside the jitted step.
+    """
+
+    def __init__(self, max_out_of_orderness: Time):
+        if max_out_of_orderness.to_milliseconds() < 0:
+            raise ValueError(
+                "Tried to set the maximum allowed lateness to "
+                f"{max_out_of_orderness}. This parameter cannot be negative."
+            )
+        self.max_out_of_orderness = max_out_of_orderness.to_milliseconds()
+        self.current_max_timestamp = LONG_MIN + self.max_out_of_orderness
+        self.last_emitted_watermark = LONG_MIN
+
+    def get_max_out_of_orderness_in_millis(self) -> int:
+        return self.max_out_of_orderness
+
+    def get_current_watermark(self) -> Watermark:
+        potential = self.current_max_timestamp - self.max_out_of_orderness
+        if potential >= self.last_emitted_watermark:
+            self.last_emitted_watermark = potential
+        return Watermark(self.last_emitted_watermark)
+
+    def observe(self, timestamp: int) -> int:
+        if timestamp > self.current_max_timestamp:
+            self.current_max_timestamp = timestamp
+        return timestamp

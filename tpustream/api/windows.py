@@ -1,0 +1,118 @@
+"""Window assigners: tumbling, sliding, session, count.
+
+The reference exercises tumbling (`timeWindow(Time.minutes(1))`,
+chapter2/.../ComputeCpuAvg.java:29) and sliding
+(`timeWindow(Time.minutes(5), Time.seconds(5))`,
+chapter3/.../BandwidthMonitorWithEventTime.java:46) windows, documents
+session windows (chapter3/README.md:412-428) and mentions count windows
+(chapter2/README.md teaser). On the TPU runtime every time window is
+decomposed into *panes* of ``gcd(size, slide)`` milliseconds: per-record
+work is a single scatter into a (key, pane) accumulator ring, and a window
+fire composes its panes with a matmul against a static ring-selection
+matrix — SURVEY.md §5 "pane-sharded reduction".
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from .timeapi import Time, TimeCharacteristic
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    kind: str                     # "tumbling" | "sliding" | "session" | "count"
+    size_ms: int = 0
+    slide_ms: int = 0             # == size_ms for tumbling
+    gap_ms: int = 0               # session gap
+    count: int = 0                # count windows
+    time_domain: TimeCharacteristic = TimeCharacteristic.ProcessingTime
+
+    @property
+    def pane_ms(self) -> int:
+        """Pane granularity: gcd of size and slide (Flink allows
+        non-divisible size/slide; the gcd pane makes both exact)."""
+        return math.gcd(self.size_ms, self.slide_ms)
+
+    @property
+    def panes_per_window(self) -> int:
+        return self.size_ms // self.pane_ms
+
+    @property
+    def panes_per_slide(self) -> int:
+        return self.slide_ms // self.pane_ms
+
+    def is_time_window(self) -> bool:
+        return self.kind in ("tumbling", "sliding")
+
+
+class TumblingEventTimeWindows:
+    @staticmethod
+    def of(size: Time) -> WindowSpec:
+        s = size.to_milliseconds()
+        return WindowSpec("tumbling", s, s, time_domain=TimeCharacteristic.EventTime)
+
+
+class TumblingProcessingTimeWindows:
+    @staticmethod
+    def of(size: Time) -> WindowSpec:
+        s = size.to_milliseconds()
+        return WindowSpec("tumbling", s, s, time_domain=TimeCharacteristic.ProcessingTime)
+
+
+class SlidingEventTimeWindows:
+    @staticmethod
+    def of(size: Time, slide: Time) -> WindowSpec:
+        return WindowSpec(
+            "sliding", size.to_milliseconds(), slide.to_milliseconds(),
+            time_domain=TimeCharacteristic.EventTime,
+        )
+
+
+class SlidingProcessingTimeWindows:
+    @staticmethod
+    def of(size: Time, slide: Time) -> WindowSpec:
+        return WindowSpec(
+            "sliding", size.to_milliseconds(), slide.to_milliseconds(),
+            time_domain=TimeCharacteristic.ProcessingTime,
+        )
+
+
+class EventTimeSessionWindows:
+    @staticmethod
+    def with_gap(gap: Time) -> WindowSpec:
+        return WindowSpec("session", gap_ms=gap.to_milliseconds(),
+                          time_domain=TimeCharacteristic.EventTime)
+
+    withGap = with_gap
+
+
+class ProcessingTimeSessionWindows:
+    @staticmethod
+    def with_gap(gap: Time) -> WindowSpec:
+        return WindowSpec("session", gap_ms=gap.to_milliseconds(),
+                          time_domain=TimeCharacteristic.ProcessingTime)
+
+    withGap = with_gap
+
+
+def time_window_spec(
+    characteristic: TimeCharacteristic, size: Time, slide: Optional[Time] = None
+) -> WindowSpec:
+    """``KeyedStream.timeWindow`` dispatch: tumbling or sliding in the
+    environment's time characteristic (Flink KeyedStream.timeWindow)."""
+    domain = characteristic
+    if domain == TimeCharacteristic.IngestionTime:
+        # ingestion time runs on the event-time machinery with source-assigned
+        # timestamps (chapter3/README.md:120)
+        domain = TimeCharacteristic.EventTime
+    s = size.to_milliseconds()
+    if slide is None:
+        return WindowSpec("tumbling", s, s, time_domain=domain)
+    return WindowSpec("sliding", s, slide.to_milliseconds(), time_domain=domain)
+
+
+def count_window_spec(count: int) -> WindowSpec:
+    return WindowSpec("count", count=int(count))

@@ -1,0 +1,61 @@
+"""Central runtime configuration.
+
+The reference hardcodes every knob in its jobs (host/port at
+chapter1/.../Main.java:17, threshold at :31, window sizes at
+chapter2/.../ComputeCpuAvg.java:29, lateness bound at
+chapter3/.../BandwidthMonitorWithEventTime.java:30); SURVEY.md §5 asks for
+one dataclass centralizing defaults while job scripts stay equally simple.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class StreamConfig:
+    # -- batching -----------------------------------------------------------
+    batch_size: int = 8192            # records per device step (static shape)
+    max_batch_delay_ms: float = 5.0   # max host-side wait to fill a batch
+
+    # -- keyed state --------------------------------------------------------
+    key_capacity: int = 1024          # dense keyed-state slots per job
+                                      # (bench configs raise to >=1<<20)
+
+    # -- windows ------------------------------------------------------------
+    pane_ring_slack: int = 16         # extra pane slots beyond (size+delay)/pane
+    max_fires_per_step: Optional[int] = None  # default: pane ring length
+    process_buffer_capacity: int = 128  # per-(key,pane) element buffer for
+                                        # full-window process() functions
+
+    # -- emission / alerts --------------------------------------------------
+    alert_capacity: int = 65536       # compacted device->host alert slots/step
+
+    # -- numerics -----------------------------------------------------------
+    # float64 reproduces the reference's Java-double golden outputs exactly
+    # (chapter2/README.md:162). TPU benchmark configs use float32/int32.
+    value_dtype: str = "float64"
+    acc_dtype: str = "float64"
+    ts_dtype: str = "int64"
+
+    # -- parallelism --------------------------------------------------------
+    parallelism: int = 1              # number of mesh shards (devices)
+    print_parallelism: Optional[int] = None  # subtask count for the `n>`
+                                             # print prefix; None = parallelism
+                                             # (prefix omitted when it is 1,
+                                             # matching Flink)
+    exchange_capacity_factor: float = 2.0  # per-destination all_to_all slots
+                                           # = factor * local_batch / shards
+
+    # -- misc ---------------------------------------------------------------
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval_batches: int = 0  # 0 = disabled
+    collect_metrics: bool = True
+
+    extra: dict = field(default_factory=dict)
+
+    def replace(self, **kw) -> "StreamConfig":
+        import dataclasses
+
+        return dataclasses.replace(self, **kw)

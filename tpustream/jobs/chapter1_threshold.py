@@ -1,0 +1,35 @@
+"""Chapter 1: CPU threshold alert.
+
+TPU-native port of reference chapter1/.../Main.java:15-34: socket source
+-> parse ``ts host cpu usage`` -> Tuple3(host, cpu, usage) -> keep
+usage > 90 -> print. The quirky job name "Window WordCount" is preserved
+(Main.java:34).
+"""
+
+from __future__ import annotations
+
+from tpustream import StreamExecutionEnvironment, Tuple3
+from tpustream.javacompat import Double
+
+
+def parse(value: str) -> Tuple3:
+    items = value.split(" ")
+    host = items[1]
+    cpu = items[2]
+    usage = Double.parseDouble(items[3])
+    return Tuple3(host, cpu, usage)
+
+
+def build(env: StreamExecutionEnvironment, text):
+    return text.map(parse).filter(lambda value: value.f2 > 90)
+
+
+def main(host: str = "localhost", port: int = 8080) -> None:
+    env = StreamExecutionEnvironment.get_execution_environment()
+    text = env.socket_text_stream(host, port)
+    build(env, text).print()
+    env.execute("Window WordCount")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Chapter 2: per-host historical CPU peak (rolling max).
+
+TPU-native port of reference chapter2/.../ComputeCpuMax.java:14-28:
+parse -> Tuple3(host, cpu, usage) -> keyBy(0) -> max(2) -> print, with
+Flink's exact rolling-max semantics: every record emits, only field 2
+updates, other fields keep first-seen values (chapter2/README.md:52-66).
+"""
+
+from __future__ import annotations
+
+from tpustream import StreamExecutionEnvironment, Tuple3
+from tpustream.javacompat import Double
+
+
+def parse(value: str) -> Tuple3:
+    items = value.split(" ")
+    return Tuple3(items[1], items[2], Double.parseDouble(items[3]))
+
+
+def build(env: StreamExecutionEnvironment, text):
+    return text.map(parse).key_by(0).max(2)
+
+
+def main(host: str = "localhost", port: int = 8080) -> None:
+    env = StreamExecutionEnvironment.get_execution_environment()
+    text = env.socket_text_stream(host, port)
+    build(env, text).print()
+    env.execute("ComputeCpuMax")
+
+
+if __name__ == "__main__":
+    main()

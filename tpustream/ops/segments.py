@@ -1,0 +1,76 @@
+"""Segmented (per-key) batch combines via sort + associative scan.
+
+The reference's keyed hot loops are record-at-a-time ("lookup key state,
+compare, update, emit" — SURVEY.md §3.2); the TPU equivalent processes a
+whole batch at once: stable-sort records by key, run a segmented
+``jax.lax.associative_scan`` with the user combiner, and scatter segment
+tails into dense keyed state. Arrival order within the batch is preserved
+by the stable composite sort key, so "first record wins" semantics
+(Flink's ``max(pos)`` keeping first-seen non-aggregated fields,
+chapter2/README.md:60-66) hold exactly.
+
+Combiners must be associative — the same contract Flink imposes on
+``AggregateFunction.merge`` (chapter2/.../ComputeCpuAvg.java:53-58).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_by_key(keys: jnp.ndarray, valid: jnp.ndarray):
+    """Stable order: by key id, invalid rows last, ties by arrival position.
+
+    Returns (perm, sorted_keys, sorted_valid, seg_starts) where
+    ``seg_starts[i]`` is True at the first row of each key segment.
+    """
+    n = keys.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int64)
+    big = jnp.int64(1) << 40
+    composite = jnp.where(valid, keys.astype(jnp.int64), big) * n + pos
+    perm = jnp.argsort(composite)
+    sk = keys[perm]
+    sv = valid[perm]
+    seg_starts = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sk[1:] != sk[:-1]]
+    )
+    seg_starts = jnp.logical_or(seg_starts, ~sv)  # invalid rows isolate
+    return perm, sk, sv, seg_starts
+
+
+def segmented_scan(
+    values: Any, seg_starts: jnp.ndarray, combine: Callable[[Any, Any], Any]
+) -> Any:
+    """Inclusive per-segment scan of a pytree of [B, ...] leaves."""
+    flags = ~seg_starts  # True = absorb previous
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        merged = combine(va, vb)
+        out = jax.tree_util.tree_map(
+            lambda m, x: jnp.where(_bcast(fb, x), m, x), merged, vb
+        )
+        return (jnp.logical_and(fa, fb), out)
+
+    _, scanned = jax.lax.associative_scan(comb, (flags, values))
+    return scanned
+
+
+def _bcast(flag, x):
+    extra = x.ndim - flag.ndim
+    return flag.reshape(flag.shape + (1,) * extra)
+
+
+def segment_tails(seg_starts: jnp.ndarray) -> jnp.ndarray:
+    """Mask of last row of each segment."""
+    return jnp.concatenate([seg_starts[1:], jnp.ones((1,), dtype=bool)])
+
+
+def inverse_permutation(perm: jnp.ndarray) -> jnp.ndarray:
+    n = perm.shape[0]
+    inv = jnp.zeros(n, dtype=perm.dtype).at[perm].set(jnp.arange(n, dtype=perm.dtype))
+    return inv

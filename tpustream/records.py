@@ -1,0 +1,138 @@
+"""Columnar record batches and string interning.
+
+The TPU runtime never sees one record at a time: the host assembles
+structure-of-arrays batches (SURVEY.md §7 design stance) — int64 event
+timestamps, int32 interned string ids, float64/int64 values, and a validity
+mask — and the jitted step consumes fixed-shape device arrays. Strings are
+interned to dense ids so keyed state can live in dense HBM arrays and
+``keyBy`` reduces to integer routing (the reference's hash-partitioned
+exchange, chapter2/.../ComputeCpuMax.java:26, becomes ``id % shards``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+F64 = "f64"
+I64 = "i64"
+STR = "str"
+BOOL = "bool"
+
+NUMPY_DTYPES = {F64: np.float64, I64: np.int64, STR: np.int32, BOOL: np.bool_}
+
+
+class StringTable:
+    """Bidirectional string <-> dense int32 id map.
+
+    Ids are assigned densely in first-seen order, so they double as keyed
+    state slot indices. ``NONE_ID`` (-1) marks padding rows.
+    """
+
+    NONE_ID = -1
+
+    def __init__(self) -> None:
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[str] = []
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def intern_many(self, strings) -> np.ndarray:
+        out = np.empty(len(strings), dtype=np.int32)
+        intern = self.intern
+        for j, s in enumerate(strings):
+            out[j] = intern(s)
+        return out
+
+    def lookup(self, i: int) -> str:
+        return self._to_str[i]
+
+    def lookup_many(self, ids: np.ndarray) -> List[str]:
+        table = self._to_str
+        return [table[i] for i in ids]
+
+    def state_dict(self) -> dict:
+        return {"strings": list(self._to_str)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._to_str = list(state["strings"])
+        self._to_id = {s: i for i, s in enumerate(self._to_str)}
+
+
+@dataclass
+class Column:
+    """One field column: numpy data plus logical kind."""
+
+    kind: str                       # F64 | I64 | STR | BOOL
+    data: np.ndarray
+    table: Optional[StringTable] = None   # for STR columns
+
+    def __post_init__(self) -> None:
+        want = NUMPY_DTYPES[self.kind]
+        if self.data.dtype != want:
+            self.data = self.data.astype(want)
+
+
+@dataclass
+class Batch:
+    """A host-side micro-batch: aligned columns + event-time + validity."""
+
+    n: int
+    columns: List[Column]
+    ts: Optional[np.ndarray] = None       # int64 epoch ms (event time)
+    proc_ts: Optional[np.ndarray] = None  # int64 epoch ms (processing time)
+    valid: Optional[np.ndarray] = None
+
+    def __post_init__(self) -> None:
+        if self.valid is None:
+            self.valid = np.ones(self.n, dtype=np.bool_)
+
+    @property
+    def arity(self) -> int:
+        return len(self.columns)
+
+    def pad_to(self, size: int) -> "Batch":
+        """Pad all columns with invalid rows up to ``size`` (static shapes)."""
+        if self.n == size:
+            return self
+        if self.n > size:
+            raise ValueError(f"batch of {self.n} exceeds target size {size}")
+        pad = size - self.n
+
+        def _pad(a: np.ndarray, fill) -> np.ndarray:
+            return np.concatenate([a, np.full(pad, fill, dtype=a.dtype)])
+
+        cols = [
+            Column(c.kind, _pad(c.data, StringTable.NONE_ID if c.kind == STR else 0), c.table)
+            for c in self.columns
+        ]
+        ts = _pad(self.ts, 0) if self.ts is not None else None
+        proc = _pad(self.proc_ts, 0) if self.proc_ts is not None else None
+        valid = np.concatenate([self.valid, np.zeros(pad, dtype=np.bool_)])
+        return Batch(size, cols, ts, proc, valid)
+
+    def row(self, i: int):
+        """Materialize row ``i`` as Python values (for slow/host paths)."""
+        out = []
+        for c in self.columns:
+            v = c.data[i]
+            if c.kind == STR:
+                out.append(c.table.lookup(int(v)) if int(v) >= 0 else None)
+            elif c.kind == F64:
+                out.append(float(v))
+            elif c.kind == BOOL:
+                out.append(bool(v))
+            else:
+                out.append(int(v))
+        return out

@@ -1,0 +1,164 @@
+"""Device-side record chain tracing.
+
+Post-parse ``map``/``filter`` user functions (e.g. the Mbps conversion at
+reference chapter3/.../BandwidthMonitorWithEventTime.java:48-53 and the
+``f2 > 90`` threshold at chapter1/.../Main.java:27-33) are traced ONCE
+with per-record jax scalars and vmapped over the batch, fusing into the
+job's single XLA program. Filters never compact (masks only — static
+shapes); string-typed fields travel as interned int32 ids wrapped in
+``StrVal`` so equality tests against literals still work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.functions import as_callable
+from ..api.tuples import TupleBase, make_tuple
+from ..records import BOOL, F64, I64, STR, StringTable
+
+
+class StrVal:
+    """A string-valued field on device: an interned id scalar + its table."""
+
+    def __init__(self, id_scalar, table: StringTable):
+        self.id = id_scalar
+        self.table = table
+
+    def _other_id(self, other):
+        if isinstance(other, StrVal):
+            return other.id
+        if isinstance(other, str):
+            return self.table.intern(other)
+        return NotImplemented
+
+    def __eq__(self, other):  # type: ignore[override]
+        oid = self._other_id(other)
+        if oid is NotImplemented:
+            return NotImplemented
+        return self.id == oid
+
+    def __ne__(self, other):  # type: ignore[override]
+        oid = self._other_id(other)
+        if oid is NotImplemented:
+            return NotImplemented
+        return self.id != oid
+
+    def __hash__(self):  # pragma: no cover - tracers aren't hashable anyway
+        raise TypeError("StrVal is not hashable during tracing")
+
+
+def wrap_record(kinds: Sequence[str], tables: Sequence[Optional[StringTable]], scalars):
+    vals = [
+        StrVal(s, t) if k == STR else s
+        for k, t, s in zip(kinds, tables, scalars)
+    ]
+    return vals[0] if len(vals) == 1 else make_tuple(*vals)
+
+
+def unwrap_record(rec) -> Tuple[list, list, list]:
+    """Record -> (scalars, kinds, tables). Classifies by value type."""
+    if isinstance(rec, (TupleBase, tuple)):
+        vals = list(rec)
+    else:
+        vals = [rec]
+    scalars, kinds, tables = [], [], []
+    for v in vals:
+        if isinstance(v, StrVal):
+            scalars.append(v.id)
+            kinds.append(STR)
+            tables.append(v.table)
+        elif isinstance(v, bool):
+            scalars.append(jnp.asarray(v))
+            kinds.append(BOOL)
+            tables.append(None)
+        elif isinstance(v, (int, np.integer)):
+            scalars.append(jnp.asarray(v, dtype=jnp.int64))
+            kinds.append(I64)
+            tables.append(None)
+        elif isinstance(v, (float, np.floating)):
+            scalars.append(jnp.asarray(v, dtype=jnp.float64))
+            kinds.append(F64)
+            tables.append(None)
+        else:
+            arr = jnp.asarray(v)
+            scalars.append(arr)
+            if arr.dtype == jnp.bool_:
+                kinds.append(BOOL)
+            elif jnp.issubdtype(arr.dtype, jnp.floating):
+                kinds.append(F64)
+            else:
+                kinds.append(I64)
+            tables.append(None)
+    return scalars, kinds, tables
+
+
+class DeviceChain:
+    """A compiled sequence of map/filter ops over record scalars.
+
+    ``apply(cols, mask)`` is jax-traceable and vmaps the per-record chain;
+    ``out_kinds``/``out_tables`` describe the emitted record layout
+    (resolved at build time by a concrete dry run).
+    """
+
+    def __init__(
+        self,
+        ops: List[Tuple[str, Callable]],
+        in_kinds: List[str],
+        in_tables: List[Optional[StringTable]],
+    ):
+        self.ops = [
+            (op, as_callable(fn, "map" if op == "map" else "filter"))
+            for op, fn in ops
+        ]
+        self.in_kinds = list(in_kinds)
+        self.in_tables = list(in_tables)
+        self.out_kinds, self.out_tables = self._infer_output()
+
+    def _record_fn(self, scalars, keep):
+        rec = wrap_record(self.in_kinds, self.in_tables, scalars)
+        for op, fn in self.ops:
+            if op == "map":
+                rec = fn(rec)
+            else:
+                keep = jnp.logical_and(keep, fn(rec))
+        out_scalars, kinds, tables = unwrap_record(rec)
+        return out_scalars, keep, kinds, tables
+
+    def _infer_output(self):
+        dummy = []
+        for k in self.in_kinds:
+            if k == F64:
+                dummy.append(jnp.asarray(1.0, dtype=jnp.float64))
+            elif k == BOOL:
+                dummy.append(jnp.asarray(True))
+            else:
+                dummy.append(
+                    jnp.asarray(0, dtype=jnp.int32 if k == STR else jnp.int64)
+                )
+        _, _, kinds, tables = self._record_fn(dummy, jnp.asarray(True))
+        return kinds, tables
+
+    @property
+    def out_arity(self) -> int:
+        return len(self.out_kinds)
+
+    def apply(self, cols: Sequence[Any], mask):
+        """Vectorized over the batch: cols are [B] arrays, mask bool[B]."""
+        if not self.ops:
+            return list(cols), mask
+
+        def per_record(scalars, keep):
+            out, k, _, _ = self._record_fn(list(scalars), keep)
+            return tuple(out), k
+
+        out_cols, out_mask = jax.vmap(per_record)(tuple(cols), mask)
+        return list(out_cols), out_mask
+
+
+def identity_chain(kinds, tables) -> DeviceChain:
+    return DeviceChain([], kinds, tables)

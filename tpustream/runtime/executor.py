@@ -1,0 +1,357 @@
+"""Job execution: host pipeline driving the compiled device program.
+
+The run loop realizes SURVEY.md §7's design stance: the host turns the
+byte stream into fixed-size structure-of-arrays batches; one jitted XLA
+program advances ``(state, batch) -> (state', emissions)``; sinks format
+compacted emissions. Processing-time fires are driven by a monotone host
+clock (virtual under the deterministic replay source), event-time fires
+purely by the data-derived watermark — so every golden transcript from
+the reference READMEs replays exactly (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.functions import as_callable
+from ..api.watermarks import (
+    MAX_WATERMARK,
+    AssignerWithPunctuatedWatermarks,
+)
+from ..config import StreamConfig
+from ..hostparse import PlanEvaluator, run_fallback_map
+from ..records import STR, Batch, Column, StringTable
+from ..api.timeapi import TimeCharacteristic
+from .metrics import Metrics, Stopwatch
+from .plan import JobPlan, build_plan
+from .sinks import CollectSink, EmissionFormatter, FnSink, PrintSink
+from .step import LONG_MIN, build_program
+
+
+class HostStage:
+    """Raw lines -> columnar Batch (parse, timestamps, raw-stage ops)."""
+
+    def __init__(self, plan: JobPlan, cfg: StreamConfig):
+        self.plan = plan
+        self.cfg = cfg
+        self._ts_eval: Optional[PlanEvaluator] = None
+        self._map_evals: Dict[int, PlanEvaluator] = {}
+        if plan.ts_expr is not None:
+            self._ts_eval = PlanEvaluator([plan.ts_expr], [None])
+
+    def _timestamps(self, lines: List[str]) -> Optional[np.ndarray]:
+        plan = self.plan
+        if plan.ts_assigner is None:
+            return None
+        if self._ts_eval is not None:
+            (ts,) = self._ts_eval(lines)
+            return np.asarray(ts, dtype=np.int64)
+        extract = plan.ts_assigner.extract_timestamp
+        return np.asarray([extract(l) for l in lines], dtype=np.int64)
+
+    def _punctuated_wm(self, lines: List[str], ts: np.ndarray) -> Optional[int]:
+        a = self.plan.ts_assigner
+        if not isinstance(a, AssignerWithPunctuatedWatermarks):
+            return None
+        wm = None
+        for line, t in zip(lines, ts):
+            w = a.check_and_get_next_watermark(line, int(t))
+            if w is not None:
+                wm = w.timestamp if wm is None else max(wm, w.timestamp)
+        return wm
+
+    def process(self, lines: List[str], proc_ts: np.ndarray):
+        """Returns (Batch, wm_hint) — Batch is None for empty input."""
+        plan = self.plan
+        if not lines:
+            return None, None
+        ts = self._timestamps(lines)
+        wm_hint = self._punctuated_wm(lines, ts) if ts is not None else None
+
+        cols: Optional[List[np.ndarray]] = None
+        for i, hop in enumerate(plan.host_ops):
+            if hop.op == "filter":
+                fn = as_callable(hop.fn, "filter")
+                keep = [bool(fn(l)) for l in lines]
+                lines = [l for l, k in zip(lines, keep) if k]
+                sel = np.asarray(keep, dtype=bool)
+                proc_ts = proc_ts[sel]
+                if ts is not None:
+                    ts = ts[sel]
+                if not lines:
+                    return None, wm_hint
+                continue
+            if hop.op == "flat_map":
+                fn = as_callable(hop.fn, "flat_map")
+                new_lines, new_proc, new_ts = [], [], []
+                for j, l in enumerate(lines):
+                    outs = list(fn(l))
+                    new_lines.extend(outs)
+                    new_proc.extend([proc_ts[j]] * len(outs))
+                    if ts is not None:
+                        new_ts.extend([ts[j]] * len(outs))
+                lines = new_lines
+                proc_ts = np.asarray(new_proc, dtype=np.int64)
+                ts = np.asarray(new_ts, dtype=np.int64) if ts is not None else None
+                if not lines:
+                    return None, wm_hint
+                continue
+            # map: symbolic fast path or per-record fallback
+            if hop.plan is not None and hop.plan.fallback_fn is None:
+                ev = self._map_evals.get(i)
+                if ev is None:
+                    tables = [
+                        t if k == STR else None
+                        for k, t in zip(plan.record_kinds, plan.tables)
+                    ]
+                    ev = PlanEvaluator(hop.plan.outputs, tables)
+                    self._map_evals[i] = ev
+                cols = ev(lines)
+            else:
+                fb = hop.plan.fallback_fn if hop.plan else as_callable(hop.fn, "map")
+                cols, kinds = run_fallback_map(fb, lines, plan.tables)
+                if not plan.record_kinds:
+                    plan.record_kinds.extend(kinds)
+            break  # planner guarantees ops after the parse map are device-side
+
+        if cols is None:
+            # stream stays raw strings: one interned STR column
+            if not plan.record_kinds:
+                plan.record_kinds.append(STR)
+                plan.tables.append(StringTable())
+            cols = [plan.tables[0].intern_many(lines)]
+
+        columns = [
+            Column(k, c, t)
+            for k, c, t in zip(plan.record_kinds, cols, plan.tables)
+        ]
+        return (
+            Batch(len(lines), columns, ts=ts, proc_ts=proc_ts),
+            wm_hint,
+        )
+
+
+class JobResult:
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+
+    def summary(self) -> dict:
+        return self.metrics.summary()
+
+
+def _make_sinks(plan: JobPlan, cfg: StreamConfig):
+    pp = cfg.print_parallelism if cfg.print_parallelism is not None else cfg.parallelism
+    sinks = []
+    for node in plan.sink_nodes:
+        if node.op == "sink_print":
+            sinks.append(PrintSink(parallelism=pp))
+        elif node.op == "sink_collect":
+            sinks.append(CollectSink(node.params["handle"]))
+        else:
+            sinks.append(FnSink(node.params["fn"]))
+    side = {}
+    for so in plan.side_outputs:
+        node = so.sink_node
+        if node.op == "sink_print":
+            s = PrintSink(parallelism=pp)
+        elif node.op == "sink_collect":
+            s = CollectSink(node.params["handle"])
+        else:
+            s = FnSink(node.params["fn"])
+        side[so.tag.id] = (so, s)
+    return sinks, side
+
+
+class Runner:
+    """Feeds padded batches through the jitted program and fans emissions
+    out to sinks."""
+
+    def __init__(self, plan: JobPlan, cfg: StreamConfig, metrics: Metrics):
+        self.plan = plan
+        self.cfg = cfg
+        self.metrics = metrics
+        self.program = build_program(plan, cfg)
+        self.step = self.program.jitted_step()
+        self.state = self.program.init_state()
+        self.sinks, self.side_sinks = _make_sinks(plan, cfg)
+        self.formatter = EmissionFormatter(
+            self.program.out_kinds, self.program.out_tables
+        )
+        self.in_kinds = plan.record_kinds
+        self._empty_cache = None
+
+    def _check_capacity(self):
+        if self.plan.key_pos is None:
+            return
+        table = self.program.pre_chain.out_tables[self.plan.key_pos]
+        if table is not None and len(table) > self.cfg.key_capacity:
+            raise RuntimeError(
+                f"distinct keys ({len(table)}) exceed StreamConfig.key_capacity "
+                f"({self.cfg.key_capacity}); raise key_capacity"
+            )
+
+    def _device_inputs(self, batch: Batch, domain: TimeCharacteristic):
+        cols = tuple(jnp.asarray(c.data) for c in batch.columns)
+        valid = jnp.asarray(batch.valid)
+        if domain == TimeCharacteristic.EventTime and batch.ts is not None:
+            ts = jnp.asarray(batch.ts)
+        else:
+            ts = jnp.asarray(
+                batch.proc_ts
+                if batch.proc_ts is not None
+                else np.zeros(batch.n, dtype=np.int64)
+            )
+        return cols, valid, ts
+
+    def feed(self, batch: Batch, wm_lower: int):
+        cfg = self.cfg
+        self._check_capacity()
+        for start in range(0, batch.n, cfg.batch_size):
+            sub = Batch(
+                min(cfg.batch_size, batch.n - start),
+                [
+                    Column(c.kind, c.data[start : start + cfg.batch_size], c.table)
+                    for c in batch.columns
+                ],
+                ts=None if batch.ts is None else batch.ts[start : start + cfg.batch_size],
+                proc_ts=None
+                if batch.proc_ts is None
+                else batch.proc_ts[start : start + cfg.batch_size],
+                valid=batch.valid[start : start + cfg.batch_size],
+            )
+            padded = sub.pad_to(cfg.batch_size)
+            cols, valid, ts = self._device_inputs(
+                padded, self.plan.time_characteristic
+            )
+            with Stopwatch() as sw:
+                self.state, emissions = self.step(
+                    self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
+                )
+                emissions = jax.device_get(emissions)
+            self.metrics.step_times_s.append(sw.elapsed)
+            self.metrics.records_in += int(sub.n)
+            self._dispatch(emissions)
+
+    def flush(self, wm_lower: int):
+        """Advance time with an empty batch (processing-time tick / EOS)."""
+        if self.plan.stateful is None or self.plan.stateful.kind in (
+            "rolling",
+            "rolling_reduce",
+        ):
+            return
+        cfg = self.cfg
+        if self._empty_cache is None:
+            cols = tuple(
+                jnp.zeros(
+                    (cfg.batch_size,),
+                    dtype=np.int32
+                    if k == STR
+                    else {"f64": np.float64, "i64": np.int64, "bool": np.bool_}[k],
+                )
+                for k in self.in_kinds
+            )
+            valid = jnp.zeros((cfg.batch_size,), dtype=bool)
+            ts = jnp.zeros((cfg.batch_size,), dtype=jnp.int64)
+            self._empty_cache = (cols, valid, ts)
+        cols, valid, ts = self._empty_cache
+        with Stopwatch() as sw:
+            self.state, emissions = self.step(
+                self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
+            )
+            emissions = jax.device_get(emissions)
+        self.metrics.step_times_s.append(sw.elapsed)
+        self._dispatch(emissions)
+
+    def _dispatch(self, emissions):
+        main = emissions.get("main")
+        if main is not None:
+            mask = np.asarray(main["mask"])
+            sel = np.nonzero(mask)[0]
+            if sel.size:
+                cols = [np.asarray(c)[sel] for c in main["cols"]]
+                subtask = main.get("subtask")
+                subtask = np.asarray(subtask)[sel] if subtask is not None else None
+                for j, row in enumerate(self.formatter.rows(cols)):
+                    st = int(subtask[j]) if subtask is not None else None
+                    for sink in self.sinks:
+                        sink.emit(row, subtask=st)
+                self.metrics.records_emitted += sel.size
+        late = emissions.get("late")
+        if late is not None and self.side_sinks:
+            self._dispatch_late(late)
+
+    def _dispatch_late(self, late):
+        mask = np.asarray(late["mask"])
+        sel = np.nonzero(mask)[0]
+        if not sel.size:
+            return
+        self.metrics.late_dropped += int(sel.size)
+        cols = [np.asarray(c)[sel] for c in late["cols"]]
+        fmt = EmissionFormatter(
+            self.program.mid_kinds, self.program.mid_tables
+        )
+        for so, sink in self.side_sinks.values():
+            for row in fmt.rows(cols):
+                keep = True
+                for op, fn in so.ops:
+                    if op == "map":
+                        row = as_callable(fn, "map")(row)
+                    else:
+                        keep = keep and bool(as_callable(fn, "filter")(row))
+                if keep:
+                    sink.emit(row)
+
+
+def execute_job(env, sink_nodes) -> JobResult:
+    cfg = env.config
+    plan = build_plan(env, sink_nodes)
+    host = HostStage(plan, cfg)
+    metrics = Metrics()
+    runner: Optional[Runner] = None
+    proc_now = 0
+    domain = plan.time_characteristic
+    bounded = plan.source.is_bounded()
+
+    def wm_lower_for_records(wm_hint: Optional[int]) -> int:
+        if domain == TimeCharacteristic.ProcessingTime:
+            return proc_now - 1
+        if wm_hint is not None:
+            return wm_hint
+        return LONG_MIN + 1
+
+    for sb in plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms):
+        with Stopwatch() as hw:
+            batch, wm_hint = host.process(sb.lines, sb.proc_ts)
+        metrics.host_times_s.append(hw.elapsed)
+        metrics.batches += 1
+        if sb.proc_ts.size:
+            proc_now = max(proc_now, int(sb.proc_ts.max()))
+        if sb.advance_proc_to is not None:
+            proc_now = max(proc_now, int(sb.advance_proc_to))
+        if batch is not None:
+            if runner is None:
+                runner = Runner(plan, cfg, metrics)
+            runner.feed(batch, wm_lower_for_records(wm_hint))
+        elif (
+            sb.advance_proc_to is not None
+            and runner is not None
+            and domain == TimeCharacteristic.ProcessingTime
+        ):
+            runner.flush(proc_now - 1)
+        if sb.final:
+            break
+
+    if runner is not None and bounded:
+        if domain == TimeCharacteristic.ProcessingTime:
+            runner.flush(proc_now - 1)
+        else:
+            # bounded event-time stream end: MAX watermark fires all windows
+            runner.flush(MAX_WATERMARK)
+
+    env.metrics = metrics
+    return JobResult(metrics)

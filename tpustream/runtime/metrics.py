@@ -1,0 +1,61 @@
+"""Per-job metrics: batches, records, emissions, step latencies.
+
+The reference has no observability beyond the print sink
+(SURVEY.md §5 "tracing/profiling: none in-repo"); this provides the
+structured per-batch counters SURVEY.md asks the build to add, plus an
+optional ``jax.profiler`` trace hook.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Metrics:
+    batches: int = 0
+    records_in: int = 0
+    records_emitted: int = 0
+    window_fires: int = 0
+    late_dropped: int = 0
+    step_times_s: List[float] = field(default_factory=list)
+    host_times_s: List[float] = field(default_factory=list)
+
+    def summary(self) -> dict:
+        total_step = sum(self.step_times_s)
+        return {
+            "batches": self.batches,
+            "records_in": self.records_in,
+            "records_emitted": self.records_emitted,
+            "window_fires": self.window_fires,
+            "late_dropped": self.late_dropped,
+            "device_time_s": total_step,
+            "host_time_s": sum(self.host_times_s),
+            "events_per_sec_device": (
+                self.records_in / total_step if total_step > 0 else None
+            ),
+        }
+
+
+class Stopwatch:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0
+        return False
+
+
+def start_device_trace(logdir: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

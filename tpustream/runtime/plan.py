@@ -1,0 +1,246 @@
+"""Job planning: lazy graph -> host parse stage + device program + sinks.
+
+Splits each job chain the way SURVEY.md §3 prescribes: string-typed
+operators near the source (parse maps, timestamp extraction) become the
+vectorized host stage; everything numeric compiles into ONE jitted device
+step (stateless chain, keyed rolling aggregate, or windowed aggregation);
+sinks and late-data side outputs run on the host over compacted emissions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from ..api.graph import Node
+from ..api.output import OutputTag
+from ..api.timeapi import TimeCharacteristic
+from ..api.watermarks import (
+    AssignerWithPeriodicWatermarks,
+    AssignerWithPunctuatedWatermarks,
+    BoundedOutOfOrdernessTimestampExtractor,
+)
+from ..api.windows import WindowSpec
+from ..records import STR, StringTable
+from .. import hostparse
+
+
+@dataclass
+class HostOp:
+    """A host-stage op over raw string lines."""
+
+    op: str                     # map | filter | flat_map
+    fn: Any
+    plan: Optional[hostparse.HostMapPlan] = None  # symbolic plan for maps
+
+
+@dataclass
+class StatefulSpec:
+    kind: str                   # rolling | rolling_reduce | window
+    # rolling
+    rolling_kind: Optional[str] = None   # max/min/sum/max_by/min_by
+    rolling_pos: Optional[int] = None
+    rolling_fn: Optional[Any] = None
+    # window
+    window: Optional[WindowSpec] = None
+    apply_kind: Optional[str] = None     # reduce | aggregate | process
+    apply_fn: Optional[Any] = None
+    allowed_lateness_ms: int = 0
+    late_tag: Optional[OutputTag] = None
+
+
+@dataclass
+class SideOutputPlan:
+    tag: OutputTag
+    ops: List[tuple] = field(default_factory=list)  # (op, fn) applied per record on host
+    sink_node: Optional[Node] = None
+
+
+@dataclass
+class JobPlan:
+    source: Any
+    host_ops: List[HostOp]
+    ts_assigner: Optional[Any]           # assigner on raw lines (or None)
+    ts_expr: Optional[hostparse.PExpr]   # symbolic timestamp plan
+    ts_delay_ms: int                     # bounded out-of-orderness
+    punctuated: bool
+    record_kinds: List[str]
+    tables: List[Optional[StringTable]]
+    device_pre: List[tuple]              # (op, fn) before the stateful op
+    key_pos: Optional[int]
+    stateful: Optional[StatefulSpec]
+    device_post: List[tuple]             # (op, fn) after the stateful op
+    sink_nodes: List[Node]
+    side_outputs: List[SideOutputPlan]
+    time_characteristic: TimeCharacteristic
+
+
+def _is_raw_stage(kinds: Optional[List[str]]) -> bool:
+    return kinds is None
+
+
+def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
+    # separate main sinks from side-output sinks
+    main_sinks: List[Node] = []
+    side_sinks: List[Node] = []
+    for s in sink_nodes:
+        chain = s.chain_to_source()
+        if any(n.op == "side_output" for n in chain):
+            side_sinks.append(s)
+        else:
+            main_sinks.append(s)
+    if not main_sinks:
+        raise RuntimeError("a job needs at least one main (non-side-output) sink")
+    first_chain = main_sinks[0].chain_to_source()[:-1]
+    for s in main_sinks[1:]:
+        if s.chain_to_source()[:-1] != first_chain:
+            raise NotImplementedError(
+                "multiple sinks are only supported on the same upstream stream"
+            )
+
+    nodes = main_sinks[0].chain_to_source()
+    assert nodes[0].op == "source"
+    source = nodes[0].params["source"]
+
+    host_ops: List[HostOp] = []
+    ts_assigner = None
+    ts_expr = None
+    ts_delay_ms = 0
+    punctuated = False
+    record_kinds: Optional[List[str]] = None
+    tables: List[Optional[StringTable]] = []
+    device_pre: List[tuple] = []
+    device_post: List[tuple] = []
+    key_pos: Optional[int] = None
+    stateful: Optional[StatefulSpec] = None
+    pending_window: Optional[Node] = None
+
+    for node in nodes[1:]:
+        op = node.op
+        if op in ("sink_print", "sink_collect", "sink_fn"):
+            continue
+        if op == "assign_ts":
+            if not _is_raw_stage(record_kinds):
+                raise NotImplementedError(
+                    "assign_timestamps_and_watermarks must precede parsing maps "
+                    "(as in reference chapter3/.../BandwidthMonitorWithEventTime.java:29)"
+                )
+            ts_assigner = node.params["assigner"]
+            if isinstance(ts_assigner, BoundedOutOfOrdernessTimestampExtractor):
+                ts_delay_ms = ts_assigner.get_max_out_of_orderness_in_millis()
+            punctuated = isinstance(ts_assigner, AssignerWithPunctuatedWatermarks)
+            ts_expr = hostparse.trace_timestamp_extractor(
+                ts_assigner.extract_timestamp
+            )
+            continue
+        if op in ("map", "filter", "flat_map"):
+            fn = node.params["fn"]
+            if _is_raw_stage(record_kinds):
+                if op == "map":
+                    plan = hostparse.trace_host_map(fn)
+                    host_ops.append(HostOp(op, fn, plan))
+                    if plan.fallback_fn is None:
+                        record_kinds = list(plan.kinds)
+                        tables = [
+                            StringTable() if k == STR else None for k in record_kinds
+                        ]
+                    else:
+                        record_kinds = []  # resolved adaptively on first batch
+                        tables = []
+                else:
+                    host_ops.append(HostOp(op, fn))
+                continue
+            target = device_post if stateful is not None else device_pre
+            if op == "flat_map":
+                raise NotImplementedError(
+                    "flat_map is only supported on the raw (pre-parse) stage"
+                )
+            target.append((op, fn))
+            continue
+        if op == "key_by":
+            if stateful is not None:
+                raise NotImplementedError("re-keying after a stateful operator")
+            key = node.params["key"]
+            if not isinstance(key, int):
+                raise NotImplementedError(
+                    "key_by currently takes a tuple field index (as the "
+                    "reference jobs do: keyBy(0)/keyBy(1))"
+                )
+            key_pos = key
+            continue
+        if op == "rolling":
+            if key_pos is None:
+                raise RuntimeError("rolling aggregates require key_by")
+            stateful = StatefulSpec(
+                "rolling",
+                rolling_kind=node.params["kind"],
+                rolling_pos=node.params["pos"],
+            )
+            continue
+        if op == "rolling_reduce":
+            if key_pos is None:
+                raise RuntimeError("reduce on a keyed stream requires key_by")
+            stateful = StatefulSpec("rolling_reduce", rolling_fn=node.params["fn"])
+            continue
+        if op == "window":
+            if key_pos is None:
+                raise RuntimeError("windows require key_by")
+            pending_window = node
+            continue
+        if op in ("window_reduce", "window_aggregate", "window_process"):
+            assert pending_window is not None
+            spec: WindowSpec = pending_window.params["spec"]
+            stateful = StatefulSpec(
+                "window",
+                window=spec,
+                apply_kind=op.removeprefix("window_"),
+                apply_fn=node.params.get("fn"),
+                allowed_lateness_ms=pending_window.params.get(
+                    "allowed_lateness_ms", 0
+                ),
+                late_tag=pending_window.params.get("late_tag"),
+            )
+            pending_window = None
+            continue
+        raise NotImplementedError(f"operator {op} not supported in this chain")
+
+    # side outputs: ops between the side_output node and the sink
+    side_outputs: List[SideOutputPlan] = []
+    for s in side_sinks:
+        chain = s.chain_to_source()
+        idx = next(i for i, n in enumerate(chain) if n.op == "side_output")
+        tag = chain[idx].params["tag"]
+        ops = []
+        for n in chain[idx + 1 :]:
+            if n.op in ("map", "filter"):
+                ops.append((n.op, n.params["fn"]))
+            elif n.op.startswith("sink_"):
+                pass
+            else:
+                raise NotImplementedError(
+                    f"operator {n.op} not supported on a side-output stream"
+                )
+        side_outputs.append(SideOutputPlan(tag=tag, ops=ops, sink_node=s))
+
+    if record_kinds is None:
+        # no parse map at all: the stream stays raw strings end to end
+        record_kinds = []
+        tables = []
+
+    return JobPlan(
+        source=source,
+        host_ops=host_ops,
+        ts_assigner=ts_assigner,
+        ts_expr=ts_expr,
+        ts_delay_ms=ts_delay_ms,
+        punctuated=punctuated,
+        record_kinds=record_kinds,
+        tables=tables,
+        device_pre=device_pre,
+        key_pos=key_pos,
+        stateful=stateful,
+        device_post=device_post,
+        sink_nodes=main_sinks,
+        side_outputs=side_outputs,
+        time_characteristic=env.time_characteristic,
+    )

@@ -1,0 +1,86 @@
+"""Sinks: Flink-style print with subtask prefixes, collect, callables.
+
+The ``print()`` sink reproduces the reference's observable format
+byte-for-byte (``3> (10.8.22.1,cpu0,80.5)``, chapter1/README.md:80-84):
+tuples render Java-``Tuple.toString`` style, doubles as
+``Double.toString`` round-trips, and the ``n>`` prefix is the 1-based
+owning subtask — the key-owner shard for keyed streams, a rotating
+assignment for stateless ones. Like Flink, the prefix is omitted when
+print parallelism is 1.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..api.tuples import _java_str, make_tuple
+from ..records import BOOL, F64, I64, STR
+
+
+class EmissionFormatter:
+    """Turns emission columns (numpy, already masked/compacted) into Python
+    row values using the planned field kinds and string tables."""
+
+    def __init__(self, kinds: List[str], tables: List[Optional[object]]):
+        self.kinds = kinds
+        self.tables = tables
+
+    def rows(self, cols: List[np.ndarray]):
+        n = len(cols[0]) if cols else 0
+        converted = []
+        for kind, col, table in zip(self.kinds, cols, self.tables):
+            if kind == STR:
+                converted.append(
+                    [table.lookup(int(i)) if int(i) >= 0 else None for i in col]
+                )
+            elif kind == F64:
+                converted.append([float(v) for v in col])
+            elif kind == BOOL:
+                converted.append([bool(v) for v in col])
+            else:
+                converted.append([int(v) for v in col])
+        for j in range(n):
+            vals = tuple(c[j] for c in converted)
+            yield vals[0] if len(vals) == 1 else make_tuple(*vals)
+
+
+class PrintSink:
+    def __init__(self, parallelism: int = 1, stream=None):
+        import sys
+
+        self.parallelism = max(1, parallelism)
+        self.stream = stream or sys.stdout
+        self._rr = 0
+        self.lines: List[str] = []  # retained for tests/inspection
+
+    def emit(self, value, subtask: Optional[int] = None) -> None:
+        body = repr(value) if not isinstance(value, str) else value
+        if not isinstance(value, (str,)) and not hasattr(value, "_FIELDS"):
+            body = _java_str(value)
+        if self.parallelism > 1:
+            if subtask is None:
+                subtask = self._rr
+                self._rr = (self._rr + 1) % self.parallelism
+            line = f"{(subtask % self.parallelism) + 1}> {body}"
+        else:
+            line = body
+        self.lines.append(line)
+        print(line, file=self.stream)
+
+
+class CollectSink:
+    def __init__(self, handle):
+        self.handle = handle
+
+    def emit(self, value, subtask: Optional[int] = None) -> None:
+        self.handle.append(value)
+
+
+class FnSink:
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def emit(self, value, subtask: Optional[int] = None) -> None:
+        self.fn(value)

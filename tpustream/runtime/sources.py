@@ -1,0 +1,173 @@
+"""Stream sources.
+
+* ``SocketTextSource`` — nc-compatible line socket, the reference's only
+  source (chapter1/.../Main.java:17, run against ``nc -lk 8080`` per
+  chapter1/README.md:65-68). A feeder thread drains the socket into a
+  queue; the executor pulls size- or deadline-bounded batches, so the
+  device pipeline sees fixed-shape micro-batches.
+
+* ``ReplaySource`` — deterministic test source (SURVEY.md §4): replays a
+  recorded list of lines with a *virtual* processing-time clock, driven by
+  ``AdvanceProcessingTime`` control tokens, so the transcripts'
+  "wait ~1 minute" steps (chapter2/README.md:160) become instantaneous
+  and exactly reproducible.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class SourceBatch:
+    """One host-side pull from a source."""
+
+    lines: List[str]
+    proc_ts: np.ndarray                 # int64 epoch ms per line
+    advance_proc_to: Optional[int] = None  # force the proc-time clock forward
+    final: bool = False                 # end of stream
+
+
+@dataclass(frozen=True)
+class AdvanceProcessingTime:
+    """Control token for ReplaySource: advance the virtual clock to ``ms``.
+
+    Stands in for the golden transcripts' wall-clock waits; processing-time
+    windows whose end <= ms fire deterministically.
+    """
+
+    ms: int
+
+
+class Source:
+    def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
+        raise NotImplementedError  # pragma: no cover
+
+    def is_bounded(self) -> bool:
+        return False
+
+
+class ReplaySource(Source):
+    def __init__(self, items: Iterable, start_ms: int = 0, ms_per_record: int = 0):
+        self.items = list(items)
+        self.start_ms = start_ms
+        self.ms_per_record = ms_per_record
+
+    def is_bounded(self) -> bool:
+        return True
+
+    def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
+        now = self.start_ms
+        lines: List[str] = []
+        times: List[int] = []
+
+        def flush(advance: Optional[int] = None, final: bool = False):
+            nonlocal lines, times
+            b = SourceBatch(lines, np.asarray(times, dtype=np.int64), advance, final)
+            lines, times = [], []
+            return b
+
+        for item in self.items:
+            if isinstance(item, AdvanceProcessingTime):
+                now = max(now, item.ms)
+                yield flush(advance=now)
+                continue
+            lines.append(item)
+            times.append(now)
+            now += self.ms_per_record
+            if len(lines) >= batch_size:
+                yield flush()
+        yield flush(final=True)
+
+
+class IterableSource(Source):
+    """Wraps any (possibly infinite) iterator of lines; wall-clock stamped."""
+
+    def __init__(self, it: Iterable, bounded: bool = True):
+        self._it = iter(it)
+        self._bounded = bounded
+
+    def is_bounded(self) -> bool:
+        return self._bounded
+
+    def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
+        lines: List[str] = []
+        now = lambda: int(_time.time() * 1000)
+        for line in self._it:
+            lines.append(line)
+            if len(lines) >= batch_size:
+                t = now()
+                yield SourceBatch(lines, np.full(len(lines), t, dtype=np.int64))
+                lines = []
+        t = now()
+        yield SourceBatch(lines, np.full(len(lines), t, dtype=np.int64), final=True)
+
+
+class SocketTextSource(Source):
+    """Line-delimited TCP socket source (reference chapter1/.../Main.java:17).
+
+    Reconnects are NOT attempted (Flink's simple socket source semantics):
+    when the server closes, the stream ends and event-time jobs flush.
+    """
+
+    def __init__(self, host: str, port: int, idle_tick_ms: float = 200.0):
+        self.host = host
+        self.port = port
+        self.idle_tick_ms = idle_tick_ms
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1 << 16)
+        self._thread: Optional[threading.Thread] = None
+
+    def _reader(self) -> None:
+        try:
+            with socket.create_connection((self.host, self.port)) as sock:
+                buf = b""
+                while True:
+                    chunk = sock.recv(1 << 16)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        self._queue.put(line.decode("utf-8", "replace").rstrip("\r"))
+                if buf:
+                    self._queue.put(buf.decode("utf-8", "replace").rstrip("\r"))
+        finally:
+            self._queue.put(None)  # sentinel: EOF
+
+    def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
+        self._thread = threading.Thread(target=self._reader, daemon=True)
+        self._thread.start()
+        done = False
+        while not done:
+            lines: List[str] = []
+            deadline = _time.monotonic() + max_delay_ms / 1000.0
+            while len(lines) < batch_size:
+                timeout = deadline - _time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if item is None:
+                    done = True
+                    break
+                lines.append(item)
+            now = int(_time.time() * 1000)
+            # idle ticks still advance the processing-time clock so
+            # processing-time windows fire without fresh input
+            yield SourceBatch(
+                lines,
+                np.full(len(lines), now, dtype=np.int64),
+                advance_proc_to=now,
+                final=done,
+            )
+            if not done and not lines:
+                _time.sleep(self.idle_tick_ms / 1000.0)

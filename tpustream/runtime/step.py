@@ -1,0 +1,133 @@
+"""Compiled device programs: one jitted XLA step per job.
+
+Phase B of every job (reference chapter1/README.md:57-61) compiles here
+into a single ``(state, batch) -> (state, emissions)`` function — the
+TPU-native replacement for Flink's thread-per-operator runtime. State is
+donated to the jit so keyed HBM arrays update in place.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.functions import as_callable
+from ..config import StreamConfig
+from ..records import BOOL, F64, I64, NUMPY_DTYPES, STR
+from ..ops import rolling as rolling_ops
+from .device import DeviceChain, unwrap_record, wrap_record
+from .plan import JobPlan
+
+LONG_MIN = -(2**63)
+
+
+def _np_dtype(kind: str):
+    return NUMPY_DTYPES[kind]
+
+
+class Emissions:
+    """Host-side view of one step's outputs (already numpy)."""
+
+    def __init__(self, streams: Dict[str, dict]):
+        self.streams = streams
+
+
+class BaseProgram:
+    """Common structure: pre chain -> stateful core -> post chain."""
+
+    def __init__(self, plan: JobPlan, cfg: StreamConfig):
+        self.plan = plan
+        self.cfg = cfg
+        self.pre_chain = DeviceChain(
+            plan.device_pre, plan.record_kinds, plan.tables
+        )
+        self.mid_kinds = self.pre_chain.out_kinds
+        self.mid_tables = self.pre_chain.out_tables
+        # post chain input kinds are set by the subclass (stateful output)
+        self.post_chain: Optional[DeviceChain] = None
+
+    # subclasses: init_state(), _step(state, cols, valid, ts, wm_lower)
+
+    def jitted_step(self):
+        return jax.jit(self._step, donate_argnums=0)
+
+
+class StatelessProgram(BaseProgram):
+    """map/filter-only pipeline (reference chapter1 job, SURVEY.md §3.1)."""
+
+    def __init__(self, plan: JobPlan, cfg: StreamConfig):
+        super().__init__(plan, cfg)
+        self.out_kinds = self.mid_kinds
+        self.out_tables = self.mid_tables
+
+    def init_state(self):
+        return {"_": jnp.zeros((), dtype=jnp.int32)}
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        out_cols, mask = self.pre_chain.apply(cols, valid)
+        return state, {
+            "main": {"mask": mask, "cols": tuple(out_cols)}
+        }
+
+
+class RollingProgram(BaseProgram):
+    """keyBy + rolling aggregate, emitting per record
+    (reference chapter2/.../ComputeCpuMax.java:26)."""
+
+    def __init__(self, plan: JobPlan, cfg: StreamConfig):
+        super().__init__(plan, cfg)
+        st = plan.stateful
+        self.key_pos = plan.key_pos
+        if st.kind == "rolling":
+            self.combine = rolling_ops.make_combiner(st.rolling_kind, st.rolling_pos)
+        else:  # rolling_reduce with a user function
+            fn = as_callable(st.rolling_fn, "reduce")
+            kinds, tables = self.mid_kinds, self.mid_tables
+
+            def combine(a, b):
+                ra = wrap_record(kinds, tables, list(a))
+                rb = wrap_record(kinds, tables, list(b))
+                out, _, _ = unwrap_record(fn(ra, rb))
+                return tuple(out)
+
+            self.combine = combine
+        self.post_chain = DeviceChain(
+            plan.device_post, self.mid_kinds, self.mid_tables
+        )
+        self.out_kinds = self.post_chain.out_kinds
+        self.out_tables = self.post_chain.out_tables
+
+    def init_state(self):
+        dtypes = [
+            _np_dtype(k) if k != STR else np.int32 for k in self.mid_kinds
+        ]
+        return rolling_ops.init_rolling_state(self.cfg.key_capacity, dtypes)
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        keys = mid_cols[self.key_pos]
+        new_state, emitted = rolling_ops.rolling_step(
+            state, keys, tuple(mid_cols), mask, self.combine
+        )
+        out_cols, out_mask = self.post_chain.apply(list(emitted), mask)
+        n_shards = max(1, self.cfg.parallelism)
+        subtask = (keys.astype(jnp.int32) % n_shards)
+        return new_state, {
+            "main": {"mask": out_mask, "cols": tuple(out_cols), "subtask": subtask}
+        }
+
+
+def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
+    if plan.stateful is None:
+        return StatelessProgram(plan, cfg)
+    if plan.stateful.kind in ("rolling", "rolling_reduce"):
+        return RollingProgram(plan, cfg)
+    if plan.stateful.kind == "window":
+        from .window_program import WindowProgram
+
+        return WindowProgram(plan, cfg)
+    raise NotImplementedError(plan.stateful.kind)

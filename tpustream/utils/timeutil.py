@@ -1,0 +1,28 @@
+"""Time parsing helpers.
+
+The event-time job parses ISO-8601 local datetimes at a fixed UTC+8 offset
+(reference chapter3/.../BandwidthMonitorWithEventTime.java:32-34:
+``LocalDateTime.parse(...).toEpochSecond(ZoneOffset.ofHours(8))``).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+
+
+def iso_local_to_epoch_sec(s: str, tz_hours: int = 8) -> int:
+    """Epoch seconds of a naive ISO-8601 local datetime at UTC+``tz_hours``.
+
+    Java semantics: ``LocalDateTime.parse(s).toEpochSecond(ZoneOffset.ofHours(h))``
+    = (seconds since epoch of s interpreted as UTC) - h*3600.
+    """
+    d = _dt.datetime.fromisoformat(s)
+    return int(d.replace(tzinfo=_dt.timezone.utc).timestamp()) - tz_hours * 3600
+
+
+def iso_local_to_epoch_sec_np(strings, tz_hours: int = 8) -> np.ndarray:
+    """Vectorized version over a sequence of ISO-8601 strings -> int64 secs."""
+    arr = np.asarray(strings, dtype="datetime64[s]")
+    return arr.astype(np.int64) - tz_hours * 3600
