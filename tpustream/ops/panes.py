@@ -1,0 +1,190 @@
+"""Pane-ring window state: the TPU-native window machinery.
+
+A sliding window of (size, slide) is decomposed into panes of
+``g = gcd(size, slide)`` ms (SURVEY.md §5 "pane-sharded reduction").
+Per-record work is O(1): scatter into a dense ``[keys, n_slots]``
+accumulator ring indexed by ``pane_id % n_slots``. A window FIRE composes
+its ``P = size//g`` panes; fire candidates are enumerated statically
+(ring slots plus P trailing window ends) so the whole thing stays inside
+one jitted program with static shapes.
+
+This replaces Flink's per-record assignment of sliding-window elements to
+all 60 overlapping windows (reference
+chapter3/.../BandwidthMonitorWithEventTime.java:46, hot loop in
+SURVEY.md §3.4) with one scatter + an amortized ring composition.
+
+Watermark semantics follow the monotone ``max_seen - delay`` contract of
+BoundedOutOfOrdernessTimestampExtractor (chapter3/README.md:380-396);
+window end ``e`` fires when the watermark first reaches ``e - 1``
+(Flink's ``window.maxTimestamp() <= watermark``), and an element is late
+when its LAST window has fired past allowed lateness
+(chapter3/README.md:209-213).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+W0 = -(2**62)  # "long min" safe against offset arithmetic
+
+
+class RingSpec(NamedTuple):
+    pane_ms: int          # pane granularity g
+    panes_per_window: int  # P
+    slide_ms: int
+    size_ms: int
+    n_slots: int          # N  (>= P + lateness horizon + slack)
+    n_fire_candidates: int  # N + P
+
+    @property
+    def lateness_horizon_panes(self) -> int:
+        return self.n_slots - self.panes_per_window
+
+
+def make_ring_spec(
+    size_ms: int,
+    slide_ms: int,
+    delay_ms: int,
+    allowed_lateness_ms: int,
+    slack: int = 16,
+) -> RingSpec:
+    import math
+
+    g = math.gcd(size_ms, slide_ms)
+    p = size_ms // g
+    horizon = -(-(delay_ms + allowed_lateness_ms) // g)  # ceil
+    n = p + horizon + slack
+    return RingSpec(g, p, slide_ms, size_ms, n, n + p)
+
+
+def pane_of(ts: jnp.ndarray, g: int) -> jnp.ndarray:
+    return jnp.floor_divide(ts, g)
+
+
+def last_window_end(ts: jnp.ndarray, spec: RingSpec) -> jnp.ndarray:
+    """End of the LAST window containing ts: the largest multiple of slide
+    that is <= ts + size (window [e-size, e) with e > ts)."""
+    return jnp.floor_divide(ts + spec.size_ms, spec.slide_ms) * spec.slide_ms
+
+
+def late_mask(ts, wm, allowed_lateness_ms: int, spec: RingSpec):
+    """True where the record is late beyond allowed lateness: all its
+    windows have fired and purged."""
+    return last_window_end(ts, spec) - 1 + allowed_lateness_ms <= wm
+
+
+def slot_targets(hi_pane, spec: RingSpec):
+    """For each ring slot s, the unique pane id in (hi-N, hi] congruent to
+    s mod N. Slots for panes the stream hasn't reached stay empty."""
+    n = spec.n_slots
+    s = jnp.arange(n, dtype=jnp.int64)
+    return hi_pane - jnp.mod(hi_pane - s, n)
+
+
+def retarget(acc_leaves, cnt, slot_pane, hi_pane, wm, spec: RingSpec, init_leaves):
+    """Advance the ring to cover (hi-N, hi]: slots whose stored pane no
+    longer matches their target are cleared (evicted).
+
+    Returns (acc_leaves, cnt, new_slot_pane, evicted_unfired_records) —
+    the count covers records in evicted panes whose last window had NOT
+    fired yet (a ring-undersized condition; n_slots must cover
+    (size + delay + lateness)/pane plus slack).
+    """
+    target = slot_targets(hi_pane, spec)
+    stale = slot_pane != target
+    last_end = (slot_pane + spec.panes_per_window) * spec.pane_ms
+    unfired = stale & (last_end - 1 > wm)
+    evicted = jnp.sum(jnp.where(unfired, jnp.sum(cnt, axis=0), 0))
+    cnt = jnp.where(stale[None, :], 0, cnt)
+    acc_leaves = [
+        jnp.where(stale[None, :], init, a)
+        for a, init in zip(acc_leaves, init_leaves)
+    ]
+    return acc_leaves, cnt, target, evicted
+
+
+def fire_candidates(hi_pane, wm_old, wm_new, spec: RingSpec):
+    """Static set of window-end candidates and which of them fire now.
+
+    Candidates are windows whose LAST pane lies in (hi-N, hi+P]: every
+    window that can still contain ring data, including the P "trailing"
+    windows that slide past the newest pane (they fire at end-of-stream /
+    clock advance). Returns (cand_last_pane [F], ends [F], fire [F]).
+    """
+    f = spec.n_fire_candidates
+    j = jnp.arange(f, dtype=jnp.int64)
+    cand = hi_pane - spec.n_slots + 1 + j
+    ends = (cand + 1) * spec.pane_ms
+    aligned = jnp.mod(ends, spec.slide_ms) == 0
+    fire = aligned & (ends - 1 <= wm_new) & (ends - 1 > wm_old)
+    return cand, ends, fire
+
+
+def compose_windows(
+    acc_leaves,
+    cnt,
+    slot_pane,
+    cand,
+    spec: RingSpec,
+    combine: Callable,
+):
+    """Fold each candidate window's panes in event-time order.
+
+    acc_leaves: list of [K, N]; cnt: [K, N]; cand: [F] last-pane ids.
+    Returns (win_leaves list of [K, F], win_cnt [K, F]).
+
+    Window counts are additive so they compose with one [N, F] matmul on
+    the MXU; generic accumulators fold with a P-step lax.scan of gathers
+    (panes ascending, so non-commutative combiners see event-time order).
+    """
+    n, f, p = spec.n_slots, spec.n_fire_candidates, spec.panes_per_window
+    # membership matrix: slot s (holding pane slot_pane[s]) belongs to
+    # candidate j iff its pane is one of the window's P panes
+    member = (slot_pane[:, None] <= cand[None, :]) & (
+        slot_pane[:, None] > (cand[None, :] - p)
+    )
+    mm = member.astype(cnt.dtype)
+    win_cnt = cnt @ mm  # [K, N] @ [N, F] on the MXU
+
+    # generic fold over panes, earliest first
+    def body(carry, o):
+        has, outs = carry
+        pane = cand - (p - 1) + o              # [F]
+        slot = jnp.mod(pane, n).astype(jnp.int32)
+        present = (slot_pane[slot] == pane) & (pane >= 0)  # slot holds pane
+        cell_cnt = cnt[:, slot]                # [K, F]
+        cell_present = present[None, :] & (cell_cnt > 0)
+        cells = [a[:, slot] for a in acc_leaves]
+        merged = combine(tuple(outs), tuple(cells))
+        new_outs = [
+            jnp.where(
+                cell_present & has, m, jnp.where(cell_present, c, o_)
+            )
+            for m, c, o_ in zip(merged, cells, outs)
+        ]
+        new_has = has | cell_present
+        return (new_has, new_outs), None
+
+    k = cnt.shape[0]
+    has0 = jnp.zeros((k, f), dtype=bool)
+    outs0 = [jnp.zeros((k, f), dtype=a.dtype) for a in acc_leaves]
+    (has, outs), _ = jax.lax.scan(
+        body, (has0, outs0), jnp.arange(p, dtype=jnp.int64)
+    )
+    return outs, win_cnt
+
+
+def compact(mask_flat: jnp.ndarray, cols, capacity: int):
+    """Device-side compaction: first `capacity` set rows of mask.
+
+    Returns (indices [A], count, overflow, gathered cols [A]).
+    """
+    count = jnp.sum(mask_flat)
+    (idx,) = jnp.nonzero(mask_flat, size=capacity, fill_value=0)
+    out_cols = [c[idx] for c in cols]
+    valid = jnp.arange(capacity) < count
+    overflow = jnp.maximum(count - capacity, 0)
+    return idx, valid, overflow, out_cols

@@ -1,0 +1,350 @@
+"""Windowed keyed aggregation as one jitted XLA program.
+
+Covers the reference's window surface: tumbling/sliding time windows in
+processing or event time with incremental ``reduce``/``aggregate``
+(chapter2/.../ComputeCpuAvg.java:27-60, chapter3/.../BandwidthMonitor.java:32-41,
+chapter3/.../BandwidthMonitorWithEventTime.java:45-55), bounded
+out-of-orderness watermarks with late-drop (chapter3/README.md:195-213),
+allowed lateness with per-arrival re-fire and late-data side output
+(chapter3/README.md:209-228).
+
+Execution model per step (SURVEY.md §7):
+  1. masked pre-chain (map/filter) over the batch,
+  2. watermark update: monotone ``max(max_seen - delay, clock_hint)``,
+  3. late split against the PRE-batch watermark,
+  4. pane scatter: sort by (key, pane) cell, segmented associative scan
+     with the user combiner, merge segment tails into the [K, N] ring,
+  5. fire: statically-enumerated window-end candidates crossing the
+     watermark compose their panes (counts via MXU matmul, accumulators
+     via an event-time-ordered fold), results run the post chain and are
+     compacted on device to `alert_capacity` rows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.functions import as_callable
+from ..api.timeapi import TimeCharacteristic
+from ..records import BOOL, F64, I64, NUMPY_DTYPES, STR
+from ..ops import panes as pane_ops
+from ..ops.panes import W0
+from ..ops.segments import (
+    inverse_permutation,
+    segment_tails,
+    segmented_scan,
+    sort_by_key,
+)
+from .device import DeviceChain, unwrap_record, wrap_record
+from .plan import JobPlan
+from .step import BaseProgram
+
+
+def _dummy_scalar(kind: str):
+    if kind == F64:
+        return jnp.asarray(1.0, dtype=jnp.float64)
+    if kind == BOOL:
+        return jnp.asarray(True)
+    return jnp.asarray(0, dtype=jnp.int32 if kind == STR else jnp.int64)
+
+
+class WindowProgram(BaseProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        st = plan.stateful
+        spec = st.window
+        if not spec.is_time_window():
+            raise NotImplementedError(
+                f"{spec.kind} windows use a dedicated program"
+            )
+        self.key_pos = plan.key_pos
+        self.apply_kind = st.apply_kind
+        if (
+            spec.time_domain == TimeCharacteristic.EventTime
+            and plan.time_characteristic == TimeCharacteristic.EventTime
+            and plan.ts_assigner is None
+        ):
+            raise RuntimeError(
+                "event-time windows need assign_timestamps_and_watermarks "
+                "before other operators (reference "
+                "chapter3/.../BandwidthMonitorWithEventTime.java:29)"
+            )
+        self.allowed_lateness_ms = st.allowed_lateness_ms
+        self.domain = spec.time_domain
+        if self.domain == TimeCharacteristic.EventTime:
+            # ingestion time rides the event machinery with delay 0
+            self.delay_ms = plan.ts_delay_ms
+        else:
+            # processing time: wm = max_proc_seen - 1 so a record at T
+            # fires windows ending <= T (timer semantics)
+            self.delay_ms = 1
+        self.ring = pane_ops.make_ring_spec(
+            spec.size_ms,
+            spec.slide_ms,
+            self.delay_ms,
+            self.allowed_lateness_ms,
+            cfg.pane_ring_slack,
+        )
+        self._build_agg()
+        self.post_chain = DeviceChain(
+            plan.device_post, self.result_kinds, self.result_tables
+        )
+        self.out_kinds = self.post_chain.out_kinds
+        self.out_tables = self.post_chain.out_tables
+
+    # ------------------------------------------------------------------
+    # aggregation plumbing: lift / combine / finalize on leaf tuples
+    # ------------------------------------------------------------------
+    def _build_agg(self) -> None:
+        st = self.plan.stateful
+        kinds, tables = self.mid_kinds, self.mid_tables
+        if self.apply_kind == "reduce":
+            fn = as_callable(st.apply_fn, "reduce")
+
+            def lift(cols):
+                return tuple(cols)
+
+            def combine(a, b):
+                ra = wrap_record(kinds, tables, list(a))
+                rb = wrap_record(kinds, tables, list(b))
+                out, _, _ = unwrap_record(fn(ra, rb))
+                return tuple(out)
+
+            def finalize(leaves):
+                return tuple(leaves)
+
+            self.acc_kinds = list(kinds)
+            self.result_kinds = list(kinds)
+            self.result_tables = list(tables)
+        elif self.apply_kind == "aggregate":
+            agg = st.apply_fn
+            create = as_callable(agg, "create_accumulator")
+            add = as_callable(agg, "add")
+            merge = as_callable(agg, "merge")
+            get_result = as_callable(agg, "get_result")
+
+            # infer accumulator layout from one concrete add
+            probe_rec = wrap_record(
+                kinds, tables, [_dummy_scalar(k) for k in kinds]
+            )
+            probe_acc = add(probe_rec, create())
+            _, acc_kinds, acc_tables = unwrap_record(probe_acc)
+            self.acc_kinds = acc_kinds
+            self._acc_tables = acc_tables
+
+            def lift(cols):
+                def one(scalars):
+                    rec = wrap_record(kinds, tables, list(scalars))
+                    out, _, _ = unwrap_record(add(rec, create()))
+                    return tuple(out)
+
+                return jax.vmap(one)(tuple(cols))
+
+            def combine(a, b):
+                ra = wrap_record(acc_kinds, acc_tables, list(a))
+                rb = wrap_record(acc_kinds, acc_tables, list(b))
+                out, _, _ = unwrap_record(merge(ra, rb))
+                return tuple(out)
+
+            def finalize(leaves):
+                rec = wrap_record(acc_kinds, acc_tables, list(leaves))
+                out, _, _ = unwrap_record(get_result(rec))
+                return tuple(out)
+
+            # result layout from a concrete probe
+            res = get_result(
+                wrap_record(acc_kinds, acc_tables, [_dummy_scalar(k) for k in acc_kinds])
+            )
+            _, rk, rt = unwrap_record(res)
+            self.result_kinds = rk
+            self.result_tables = rt
+        else:
+            raise NotImplementedError(self.apply_kind)
+        self.lift = lift
+        self.combine = combine
+        self.finalize = finalize
+
+    def _acc_dtype(self, kind: str):
+        return np.int32 if kind == STR else NUMPY_DTYPES[kind]
+
+    # ------------------------------------------------------------------
+    def init_state(self):
+        k, n = self.cfg.key_capacity, self.ring.n_slots
+        hi0 = jnp.asarray(-1, dtype=jnp.int64)
+        return {
+            "acc": [
+                jnp.zeros((k, n), dtype=self._acc_dtype(kd))
+                for kd in self.acc_kinds
+            ],
+            "cnt": jnp.zeros((k, n), dtype=jnp.int32),
+            "slot_pane": pane_ops.slot_targets(hi0, self.ring),
+            "hi": hi0,
+            "wm": jnp.asarray(W0, dtype=jnp.int64),
+            "max_ts": jnp.asarray(W0, dtype=jnp.int64),
+            "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
+            "alert_overflow": jnp.zeros((), dtype=jnp.int64),
+        }
+
+    # ------------------------------------------------------------------
+    def _scatter_batch(self, state, keys, mid_cols, live, pane):
+        """Merge the batch into the (key, pane) ring via sort + segmented
+        scan with the user combiner (arrival order preserved)."""
+        k, n = self.cfg.key_capacity, self.ring.n_slots
+        slot = jnp.mod(pane, n)
+        cell = keys.astype(jnp.int64) * n + slot
+        perm, sc, sv, seg_starts = sort_by_key(cell, live)
+        lifted = self.lift(list(mid_cols))
+        lifted_sorted = tuple(l[perm] for l in lifted)
+        prefix = segmented_scan(lifted_sorted, seg_starts, self.combine)
+        tails = segment_tails(seg_starts) & sv
+
+        flat_idx = jnp.where(tails, sc, k * n)
+        old_cnt_flat = state["cnt"].reshape(-1)
+        old_cnt = old_cnt_flat[jnp.clip(sc, 0, k * n - 1)]
+        olds = tuple(
+            a.reshape(-1)[jnp.clip(sc, 0, k * n - 1)] for a in state["acc"]
+        )
+        merged = self.combine(olds, prefix)
+        newvals = tuple(
+            jnp.where((old_cnt > 0) & sv, m, p) for m, p in zip(merged, prefix)
+        )
+        new_acc = [
+            a.reshape(-1).at[flat_idx].set(v, mode="drop").reshape(k, n)
+            for a, v in zip(state["acc"], newvals)
+        ]
+        # per-cell count increments (ones scatter-add; additive always)
+        add_idx = jnp.where(live, cell, k * n)
+        new_cnt = (
+            old_cnt_flat.at[add_idx]
+            .add(jnp.ones_like(add_idx, dtype=jnp.int32), mode="drop")
+            .reshape(k, n)
+        )
+        touched_slot = (
+            jnp.zeros((n,), dtype=jnp.int32)
+            .at[jnp.where(live, slot, n)]
+            .add(1, mode="drop")
+        ) > 0
+        return new_acc, new_cnt, touched_slot
+
+    # ------------------------------------------------------------------
+    def _fire(self, state, acc, cnt, slot_pane, hi, wm_old, wm_new, touched_slot):
+        ring = self.ring
+        k, n, f = self.cfg.key_capacity, ring.n_slots, ring.n_fire_candidates
+        cand, ends, fire = pane_ops.fire_candidates(hi, wm_old, wm_new, ring)
+        if self.allowed_lateness_ms > 0:
+            # allowed-late arrivals re-fire already-fired windows they touch
+            # (chapter3/README.md:212 option 2)
+            member = (slot_pane[:, None] <= cand[None, :]) & (
+                slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
+            )
+            dirty = (touched_slot.astype(jnp.int32) @ member.astype(jnp.int32)) > 0
+            aligned = jnp.mod(ends, ring.slide_ms) == 0
+            refire = (
+                aligned
+                & (ends - 1 <= wm_old)
+                & (ends - 1 + self.allowed_lateness_ms > wm_old)
+                & dirty
+            )
+            fire = fire | refire
+        any_fire = jnp.any(fire)
+
+        cap = self.cfg.alert_capacity
+
+        def do_fire(_):
+            win_leaves, win_cnt = pane_ops.compose_windows(
+                acc, cnt, slot_pane, cand, ring, self.combine
+            )
+            results = self.finalize(tuple(win_leaves))  # leaves [K, F]
+            emit_mask = fire[None, :] & (win_cnt > 0)   # [K, F]
+            key_col = jnp.broadcast_to(
+                jnp.arange(k, dtype=jnp.int32)[:, None], (k, f)
+            )
+            end_col = jnp.broadcast_to(ends[None, :], (k, f))
+            # order fires by (window end, key): transpose to [F, K]
+            flat = lambda x: x.T.reshape(-1)
+            cols = [flat(r) for r in results]
+            mask_flat = flat(emit_mask)
+            post_cols, post_mask = self.post_chain.apply(cols, mask_flat)
+            _, valid, overflow, out = pane_ops.compact(
+                post_mask,
+                post_cols + [flat(key_col), flat(end_col)],
+                cap,
+            )
+            return valid, out, overflow
+
+        def no_fire(_):
+            zero_cols = [
+                jnp.zeros((cap,), dtype=self._acc_dtype(kd))
+                for kd in self.post_chain.out_kinds
+            ]
+            return (
+                jnp.zeros((cap,), dtype=bool),
+                zero_cols
+                + [
+                    jnp.zeros((cap,), dtype=jnp.int32),
+                    jnp.zeros((cap,), dtype=jnp.int64),
+                ],
+                jnp.zeros((), dtype=jnp.int64),
+            )
+
+        return jax.lax.cond(any_fire, do_fire, no_fire, operand=None)
+
+    # ------------------------------------------------------------------
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        keys = mid_cols[self.key_pos].astype(jnp.int32)
+        ring = self.ring
+
+        wm_old = state["wm"]
+        batch_max = jnp.max(jnp.where(mask, ts, W0))
+        new_max = jnp.maximum(state["max_ts"], batch_max)
+        wm_new = jnp.maximum(
+            wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
+        )
+
+        late = pane_ops.late_mask(ts, wm_old, self.allowed_lateness_ms, ring) & mask
+        live = mask & ~late
+
+        pane = pane_ops.pane_of(ts, ring.pane_ms)
+        batch_hi = jnp.max(jnp.where(live, pane, -1))
+        hi = jnp.maximum(state["hi"], batch_hi)
+
+        init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
+        acc, cnt, slot_pane, evicted = pane_ops.retarget(
+            state["acc"], state["cnt"], state["slot_pane"], hi, wm_old, ring,
+            init_leaves,
+        )
+        acc, cnt, touched = self._scatter_batch(
+            {"acc": acc, "cnt": cnt}, keys, mid_cols, live, pane
+        )
+
+        emit_valid, emit_cols, overflow = self._fire(
+            state, acc, cnt, slot_pane, hi, wm_old, wm_new, touched
+        )
+
+        n_shards = max(1, self.cfg.parallelism)
+        key_out = emit_cols[-2]
+        new_state = {
+            "acc": acc,
+            "cnt": cnt,
+            "slot_pane": slot_pane,
+            "hi": hi,
+            "wm": wm_new,
+            "max_ts": new_max,
+            "evicted_unfired": state["evicted_unfired"] + evicted,
+            "alert_overflow": state["alert_overflow"] + overflow,
+        }
+        emissions = {
+            "main": {
+                "mask": emit_valid,
+                "cols": tuple(emit_cols[:-2]),
+                "subtask": key_out % n_shards,
+                "window_end": emit_cols[-1],
+            },
+            "late": {"mask": late, "cols": tuple(mid_cols)},
+        }
+        return new_state, emissions
