@@ -1,0 +1,46 @@
+"""Golden transcript for the chapter-2 windowed median
+(reference chapter2/README.md:236-250)."""
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.config import StreamConfig
+from tpustream.jobs.chapter2_median import build
+from tpustream.runtime.sources import AdvanceProcessingTime, ReplaySource
+
+LINES = [
+    "1563452056 10.8.22.1 cpu0 80.5",
+    "1563452050 10.8.22.1 cpu0 78.4",
+    "1563452056 10.8.22.1 cpu0 99.9",
+    "1563452056 10.8.22.2 cpu1 20.2",
+]
+
+
+def run(items, **cfg):
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(items))
+    handle = build(env, text).collect()
+    env.execute("ComputeCpuMiddle")
+    return handle.items
+
+
+def test_windowed_median_golden():
+    out = run(LINES + [AdvanceProcessingTime(61_000)])
+    assert out == [80.5, 20.2]
+
+
+def test_windowed_median_even_count():
+    out = run(
+        [
+            "1 h1 cpu0 1.0",
+            "1 h1 cpu0 2.0",
+            "1 h1 cpu0 10.0",
+            "1 h1 cpu0 4.0",
+            AdvanceProcessingTime(61_000),
+        ]
+    )
+    # sorted [1,2,4,10] -> (2+4)/2
+    assert out == [3.0]
+
+
+def test_windowed_median_batch_invariance():
+    out = run(LINES + [AdvanceProcessingTime(61_000)], batch_size=1)
+    assert out == [80.5, 20.2]

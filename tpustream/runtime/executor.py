@@ -268,6 +268,16 @@ class Runner:
         self._dispatch(emissions)
 
     def _dispatch(self, emissions):
+        fire_info = emissions.get("process_fire")
+        if fire_info is not None:
+            def emit(item, subtask):
+                for sink in self.sinks:
+                    sink.emit(item, subtask=subtask)
+
+            n = self.program.evaluate_fires(
+                self.state, fire_info, self.plan.device_post, emit
+            )
+            self.metrics.records_emitted += n
         main = emissions.get("main")
         if main is not None:
             mask = np.asarray(main["mask"])

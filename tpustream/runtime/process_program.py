@@ -1,0 +1,250 @@
+"""Full-window ProcessWindowFunction path (the non-incremental window).
+
+Implements reference chapter2/.../ComputeCpuMiddle.java:34-49: the window
+buffers EVERY element, and the user function sees them all at fire. On
+the TPU runtime elements are buffered in fixed-capacity per-(key, pane)
+device arrays ``[keys, slots, cap]``; at fire the host gathers the fired
+window's panes and invokes the Python ``process(key, context, elements,
+collector)`` callback. This is deliberately the slow path — the reference
+itself warns process "seriously affects efficiency" on big windows
+(chapter2/README.md:231) — flexibility runs on the host, hot loops stay
+compiled.
+
+Elements are presented in (pane, arrival) order — event-time-bucketed
+rather than Flink's pure arrival order; order-insensitive functions
+(sort/median, the reference's use) are unaffected.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.functions import Collector, WindowContext, as_callable
+from ..records import BOOL, F64, I64, NUMPY_DTYPES, STR
+from ..api.timeapi import TimeCharacteristic
+from ..ops import panes as pane_ops
+from ..ops.panes import W0
+from ..ops.segments import sort_by_key
+from ..api.tuples import make_tuple
+from .device import DeviceChain
+from .plan import JobPlan
+from .step import BaseProgram
+from .window_program import WindowProgram
+
+
+class ProcessWindowProgram(WindowProgram):
+    """Shares the watermark/ring/late machinery of WindowProgram but stores
+    raw elements and defers evaluation to a host callback."""
+
+    def _build_agg(self) -> None:
+        # no incremental aggregation: accumulators ARE the element buffers
+        self.acc_kinds = list(self.mid_kinds)
+        self.result_kinds = list(self.mid_kinds)
+        self.result_tables = list(self.mid_tables)
+        self.lift = lambda cols: tuple(cols)
+        self.combine = None
+        self.finalize = None
+        self.process_fn = as_callable(self.plan.stateful.apply_fn, "process")
+
+    @property
+    def host_evaluated(self) -> bool:
+        return True
+
+    def init_state(self):
+        k, n = self.cfg.key_capacity, self.ring.n_slots
+        cap = self.cfg.process_buffer_capacity
+        hi0 = jnp.asarray(-1, dtype=jnp.int64)
+        return {
+            "buf": [
+                jnp.zeros((k, n, cap), dtype=self._acc_dtype(kd))
+                for kd in self.acc_kinds
+            ],
+            "cnt": jnp.zeros((k, n), dtype=jnp.int32),
+            "slot_pane": pane_ops.slot_targets(hi0, self.ring),
+            "hi": hi0,
+            "wm": jnp.asarray(W0, dtype=jnp.int64),
+            "max_ts": jnp.asarray(W0, dtype=jnp.int64),
+            "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
+            "buffer_overflow": jnp.zeros((), dtype=jnp.int64),
+        }
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        keys = mid_cols[self.key_pos].astype(jnp.int32)
+        ring = self.ring
+        k, n = self.cfg.key_capacity, ring.n_slots
+        cap = self.cfg.process_buffer_capacity
+
+        wm_old = state["wm"]
+        batch_max = jnp.max(jnp.where(mask, ts, W0))
+        new_max = jnp.maximum(state["max_ts"], batch_max)
+        wm_new = jnp.maximum(
+            wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
+        )
+
+        late = pane_ops.late_mask(ts, wm_old, self.allowed_lateness_ms, ring) & mask
+        live = mask & ~late
+
+        pane = pane_ops.pane_of(ts, ring.pane_ms)
+        batch_hi = jnp.max(jnp.where(live, pane, -1))
+        hi = jnp.maximum(state["hi"], batch_hi)
+
+        # ---- retarget ring (clear stale slots incl. buffers) -------------
+        target = pane_ops.slot_targets(hi, ring)
+        stale = state["slot_pane"] != target
+        last_end = (state["slot_pane"] + ring.panes_per_window) * ring.pane_ms
+        unfired = stale & (last_end - 1 > wm_old)
+        evicted = jnp.sum(jnp.where(unfired, jnp.sum(state["cnt"], axis=0), 0))
+        cnt = jnp.where(stale[None, :], 0, state["cnt"])
+        buf = [
+            jnp.where(stale[None, :, None], jnp.zeros((), dtype=b.dtype), b)
+            for b in state["buf"]
+        ]
+        slot_pane = target
+
+        # ---- append batch elements to their cells ------------------------
+        slot = jnp.mod(pane, n)
+        cell = keys.astype(jnp.int64) * n + slot
+        perm, sc, sv, seg_starts = sort_by_key(cell, live)
+        b = keys.shape[0]
+        pos = jnp.arange(b, dtype=jnp.int64)
+        seg_first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(seg_starts, pos, 0)
+        )
+        rank = pos - seg_first
+        cell_sorted = jnp.clip(sc, 0, k * n - 1)
+        base = cnt.reshape(-1)[cell_sorted]
+        write_pos = base.astype(jnp.int64) + rank
+        fits = sv & (write_pos < cap)
+        flat_idx = jnp.where(fits, cell_sorted * cap + write_pos, k * n * cap)
+        sorted_cols = [c[perm] for c in mid_cols]
+        buf = [
+            bb.reshape(-1).at[flat_idx].set(col, mode="drop").reshape(k, n, cap)
+            for bb, col in zip(buf, sorted_cols)
+        ]
+        overflow = jnp.sum(sv & ~fits)
+        cnt = (
+            cnt.reshape(-1)
+            .at[jnp.where(live, cell, k * n)]
+            .add(jnp.ones_like(cell, dtype=jnp.int32), mode="drop")
+            .reshape(k, n)
+        )
+        touched = (
+            jnp.zeros((n,), dtype=jnp.int32)
+            .at[jnp.where(live, slot, n)]
+            .add(1, mode="drop")
+        ) > 0
+
+        # ---- fire candidates --------------------------------------------
+        cand, ends, fire = pane_ops.fire_candidates(hi, wm_old, wm_new, ring)
+        if self.allowed_lateness_ms > 0:
+            member = (slot_pane[:, None] <= cand[None, :]) & (
+                slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
+            )
+            dirty = (touched.astype(jnp.int32) @ member.astype(jnp.int32)) > 0
+            aligned = jnp.mod(ends, ring.slide_ms) == 0
+            fire = fire | (
+                aligned
+                & (ends - 1 <= wm_old)
+                & (ends - 1 + self.allowed_lateness_ms > wm_old)
+                & dirty
+            )
+        member = (slot_pane[:, None] <= cand[None, :]) & (
+            slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
+        )
+        win_cnt = cnt @ member.astype(cnt.dtype)
+
+        new_state = {
+            "buf": buf,
+            "cnt": cnt,
+            "slot_pane": slot_pane,
+            "hi": hi,
+            "wm": wm_new,
+            "max_ts": new_max,
+            "evicted_unfired": state["evicted_unfired"] + evicted,
+            "buffer_overflow": state["buffer_overflow"] + overflow,
+        }
+        emissions = {
+            "process_fire": {
+                "fire": fire,
+                "ends": ends,
+                "cand": cand,
+                "win_cnt": win_cnt,
+                "wm": wm_new,
+            },
+            "late": {"mask": late, "cols": tuple(mid_cols)},
+        }
+        return new_state, emissions
+
+    # ------------------------------------------------------------------
+    # host-side window evaluation
+    # ------------------------------------------------------------------
+    def _value(self, kind, table, v):
+        if kind == STR:
+            return table.lookup(int(v)) if int(v) >= 0 else None
+        if kind == F64:
+            return float(v)
+        if kind == BOOL:
+            return bool(v)
+        return int(v)
+
+    def evaluate_fires(self, state, fire_info, post_ops, emit):
+        """Host callback: gather fired windows' elements, run the user
+        ProcessWindowFunction, apply post ops, emit results."""
+        fire = np.asarray(fire_info["fire"])
+        if not fire.any():
+            return 0
+        win_cnt = np.asarray(fire_info["win_cnt"])
+        ends = np.asarray(fire_info["ends"])
+        cand = np.asarray(fire_info["cand"])
+        wm = int(np.asarray(fire_info["wm"]))
+        cnt = np.asarray(state["cnt"])
+        slot_pane = np.asarray(state["slot_pane"])
+        bufs = [np.asarray(b) for b in state["buf"]]
+        ring = self.ring
+        n, cap = ring.n_slots, self.cfg.process_buffer_capacity
+        kinds, tables = self.mid_kinds, self.mid_tables
+        key_table = tables[self.key_pos]
+        n_shards = max(1, self.cfg.parallelism)
+        emitted = 0
+
+        for j in np.nonzero(fire)[0]:
+            live_keys = np.nonzero(win_cnt[:, j] > 0)[0]
+            for key_id in live_keys:
+                elements = []
+                for q in range(int(cand[j]) - ring.panes_per_window + 1, int(cand[j]) + 1):
+                    s = q % n
+                    if slot_pane[s] != q or cnt[key_id, s] == 0:
+                        continue
+                    stored = min(int(cnt[key_id, s]), cap)
+                    for r in range(stored):
+                        vals = [
+                            self._value(kd, tb, b[key_id, s, r])
+                            for kd, tb, b in zip(kinds, tables, bufs)
+                        ]
+                        elements.append(
+                            vals[0] if len(vals) == 1 else make_tuple(*vals)
+                        )
+                key_val = (
+                    key_table.lookup(int(key_id))
+                    if key_table is not None
+                    else int(key_id)
+                )
+                ctx = WindowContext(int(ends[j]) - ring.size_ms, int(ends[j]), wm)
+                out = Collector()
+                self.process_fn(key_val, ctx, elements, out)
+                for item in out.items:
+                    keep = True
+                    for op, fn in post_ops:
+                        if op == "map":
+                            item = as_callable(fn, "map")(item)
+                        else:
+                            keep = keep and bool(as_callable(fn, "filter")(item))
+                    if keep:
+                        emit(item, int(key_id) % n_shards)
+                        emitted += 1
+        return emitted

@@ -127,6 +127,10 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
     if plan.stateful.kind in ("rolling", "rolling_reduce"):
         return RollingProgram(plan, cfg)
     if plan.stateful.kind == "window":
+        if plan.stateful.apply_kind == "process":
+            from .process_program import ProcessWindowProgram
+
+            return ProcessWindowProgram(plan, cfg)
         from .window_program import WindowProgram
 
         return WindowProgram(plan, cfg)

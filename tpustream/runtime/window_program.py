@@ -90,11 +90,17 @@ class WindowProgram(BaseProgram):
             cfg.pane_ring_slack,
         )
         self._build_agg()
-        self.post_chain = DeviceChain(
-            plan.device_post, self.result_kinds, self.result_tables
-        )
-        self.out_kinds = self.post_chain.out_kinds
-        self.out_tables = self.post_chain.out_tables
+        if self.apply_kind == "process":
+            # post ops run on the host over user-collected results
+            self.post_chain = None
+            self.out_kinds = list(self.result_kinds)
+            self.out_tables = list(self.result_tables)
+        else:
+            self.post_chain = DeviceChain(
+                plan.device_post, self.result_kinds, self.result_tables
+            )
+            self.out_kinds = self.post_chain.out_kinds
+            self.out_tables = self.post_chain.out_tables
 
     # ------------------------------------------------------------------
     # aggregation plumbing: lift / combine / finalize on leaf tuples
@@ -120,6 +126,9 @@ class WindowProgram(BaseProgram):
             self.acc_kinds = list(kinds)
             self.result_kinds = list(kinds)
             self.result_tables = list(tables)
+        elif self.apply_kind == "process":
+            # handled by ProcessWindowProgram override
+            raise NotImplementedError
         elif self.apply_kind == "aggregate":
             agg = st.apply_fn
             create = as_callable(agg, "create_accumulator")
