@@ -45,8 +45,12 @@ class StreamConfig:
                                              # print prefix; None = parallelism
                                              # (prefix omitted when it is 1,
                                              # matching Flink)
-    exchange_capacity_factor: float = 2.0  # per-destination all_to_all slots
-                                           # = factor * local_batch / shards
+    exchange_capacity_factor: Optional[float] = None
+    # per-destination all_to_all slots = factor * local_batch / shards.
+    # None = full local batch per destination: records can NEVER be
+    # dropped by the exchange regardless of key skew (Flink semantics).
+    # Set a factor to shrink send buffers when keys are known-uniform;
+    # overflow is then counted in state["exchange_overflow"].
 
     # -- misc ---------------------------------------------------------------
     checkpoint_dir: Optional[str] = None

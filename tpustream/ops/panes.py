@@ -123,6 +123,14 @@ def fire_candidates(hi_pane, wm_old, wm_new, spec: RingSpec):
     return cand, ends, fire
 
 
+def vary(x, axes):
+    """Mark a freshly-created constant as device-varying over ``axes`` so
+    VMA tracking under shard_map accepts it alongside sharded data."""
+    if not axes:
+        return x
+    return jax.lax.pcast(x, axes, to="varying")
+
+
 def compose_windows(
     acc_leaves,
     cnt,
@@ -130,6 +138,7 @@ def compose_windows(
     cand,
     spec: RingSpec,
     combine: Callable,
+    vary_axes=(),
 ):
     """Fold each candidate window's panes in event-time order.
 
@@ -169,8 +178,10 @@ def compose_windows(
         return (new_has, new_outs), None
 
     k = cnt.shape[0]
-    has0 = jnp.zeros((k, f), dtype=bool)
-    outs0 = [jnp.zeros((k, f), dtype=a.dtype) for a in acc_leaves]
+    has0 = vary(jnp.zeros((k, f), dtype=bool), vary_axes)
+    outs0 = [
+        vary(jnp.zeros((k, f), dtype=a.dtype), vary_axes) for a in acc_leaves
+    ]
     (has, outs), _ = jax.lax.scan(
         body, (has0, outs0), jnp.arange(p, dtype=jnp.int64)
     )

@@ -1,0 +1,79 @@
+"""Keyed record exchange: the keyBy shuffle as an ICI all_to_all.
+
+Per shard: bucket local records by destination ``key % n_shards`` into a
+fixed-capacity ``[n_shards, capacity]`` send buffer (sort by destination,
+rank within bucket), then one ``jax.lax.all_to_all`` per column moves
+every bucket to its owner. Fixed capacity keeps shapes static; overflow
+is counted, never silently dropped (SURVEY.md §2.3 "hash keys host-side
+-> all_to_all over ICI to the owning chip").
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import AXIS
+
+
+def exchange_by_key(
+    cols: List[jnp.ndarray],
+    valid: jnp.ndarray,
+    ts: jnp.ndarray,
+    keys: jnp.ndarray,
+    n_shards: int,
+    capacity: int,
+):
+    """Route records to their key-owner shard.
+
+    Returns (cols', valid', ts', overflow) with leading dim
+    ``n_shards * capacity`` (records received by this shard).
+    """
+    b = valid.shape[0]
+    dest = jnp.where(valid, keys.astype(jnp.int64) % n_shards, n_shards)
+    pos = jnp.arange(b, dtype=jnp.int64)
+    composite = dest * b + pos
+    perm = jnp.argsort(composite)  # stable by construction (unique keys)
+    dest_s = dest[perm]
+    valid_s = valid[perm]
+    seg_starts = jnp.concatenate(
+        [jnp.ones((1,), bool), dest_s[1:] != dest_s[:-1]]
+    )
+    seg_first = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(seg_starts, pos, 0)
+    )
+    rank = pos - seg_first
+    fits = valid_s & (rank < capacity)
+    overflow = jnp.sum(valid_s & ~fits)
+    send_idx = jnp.where(fits, dest_s * capacity + rank, n_shards * capacity)
+
+    def scatter(col):
+        buf = jnp.zeros((n_shards * capacity,), dtype=col.dtype)
+        return (
+            buf.at[send_idx]
+            .set(col[perm], mode="drop")
+            .reshape(n_shards, capacity)
+        )
+
+    send_valid = (
+        jnp.zeros((n_shards * capacity,), dtype=bool)
+        .at[send_idx]
+        .set(fits, mode="drop")
+        .reshape(n_shards, capacity)
+    )
+
+    def a2a(x):
+        as_bool = x.dtype == jnp.bool_
+        if as_bool:
+            x = x.astype(jnp.int8)
+        out = jax.lax.all_to_all(
+            x, AXIS, split_axis=0, concat_axis=0
+        ).reshape(n_shards * capacity, *x.shape[2:])
+        return out.astype(jnp.bool_) if as_bool else out
+
+    out_cols = [a2a(scatter(c)) for c in cols]
+    out_ts = a2a(scatter(ts))
+    out_valid = a2a(send_valid)
+    return out_cols, out_valid, out_ts, overflow

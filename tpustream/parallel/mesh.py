@@ -1,0 +1,45 @@
+"""Device mesh construction for sharded streaming jobs.
+
+The reference scales by running N parallel subtasks with a key-hash
+exchange between them (Flink's only shuffle — SURVEY.md §2.3); here the
+mesh axis ``"shards"`` plays the subtask role: keyed state is sharded
+over it, and ``keyBy`` becomes an ICI ``all_to_all``. Within a slice the
+collectives ride ICI; across hosts, initialize ``jax.distributed`` first
+(``tpustream.parallel.distributed.initialize``) and the same SPMD program
+spans DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+AXIS = "shards"
+
+
+def make_mesh(n_shards: int, devices: Optional[list] = None) -> jax.sharding.Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_shards > len(devs):
+        raise RuntimeError(
+            f"parallelism {n_shards} exceeds available devices ({len(devs)}); "
+            "use --xla_force_host_platform_device_count for CPU testing"
+        )
+    return jax.sharding.Mesh(np.array(devs[:n_shards]), (AXIS,))
+
+
+def owner_of(key_id, n_shards: int):
+    """Key-ownership function: the TPU-native analog of Flink's
+    hash(key) % parallelism routing (chapter2/.../ComputeCpuMax.java:26).
+    Interned ids are already dense and hashed on the host, so plain
+    modulo keeps state slots dense per shard."""
+    return key_id % n_shards
+
+
+def local_slot(key_id, n_shards: int):
+    return key_id // n_shards
+
+
+def global_key(local_slot_id, shard_idx, n_shards: int):
+    return local_slot_id * n_shards + shard_idx

@@ -1,0 +1,136 @@
+"""Sharded (multi-chip) program variants: SPMD over a device mesh.
+
+The single-chip programs become SPMD by overriding four hooks:
+``_exchange`` (keyBy as ICI all_to_all), ``_local_keys`` (key -> owner's
+dense slot), ``_global_max``/``_global_sum`` (watermark & counters via
+``pmax``/``psum``). Keyed state shards over the mesh axis: key ``k``
+lives on shard ``k % S`` at local row ``k // S``. The whole step runs
+under ``jax.shard_map`` so XLA schedules the collectives on ICI
+(SURVEY.md §2.3: the TPU-native equivalent of Flink's keyed exchange).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.exchange import exchange_by_key
+from ..parallel.mesh import AXIS, make_mesh
+from .plan import JobPlan
+from .step import RollingProgram
+from .window_program import WindowProgram
+
+
+def _state_specs(state) -> Any:
+    """Arrays with a key axis (ndim >= 2 or bool/field [K] vectors) shard on
+    axis 0; ring metadata and scalars replicate."""
+
+    def spec(leaf):
+        if leaf.ndim >= 2:
+            return P(AXIS)
+        return P()
+
+    return jax.tree_util.tree_map(spec, state)
+
+
+def _rolling_state_specs(state) -> Any:
+    # rolling state: seen [K], stored leaves [K] -> all sharded on axis 0
+    return jax.tree_util.tree_map(
+        lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
+    )
+
+
+class _ShardedMixin:
+    """Hook overrides shared by the sharded programs."""
+
+    def _setup_sharding(self, cfg):
+        s = cfg.parallelism
+        if cfg.key_capacity % s:
+            raise ValueError(
+                f"key_capacity ({cfg.key_capacity}) must divide evenly by "
+                f"parallelism ({s})"
+            )
+        if cfg.batch_size % s:
+            raise ValueError(
+                f"batch_size ({cfg.batch_size}) must divide evenly by "
+                f"parallelism ({s})"
+            )
+        self.n_shards = s
+        self.vary_axes = (AXIS,)
+        self.local_key_capacity = cfg.key_capacity // s
+        self.mesh = make_mesh(s)
+        local_b = cfg.batch_size // s
+        if cfg.exchange_capacity_factor is None:
+            # loss-free: worst-case all local records to one destination
+            self.exchange_capacity = local_b
+        else:
+            self.exchange_capacity = min(
+                local_b,
+                max(1, math.ceil(local_b / s * cfg.exchange_capacity_factor)),
+            )
+
+    def _global_max(self, x):
+        return jax.lax.pmax(x, AXIS)
+
+    def _global_sum(self, x):
+        return jax.lax.psum(x, AXIS)
+
+    def _exchange(self, mid_cols, mask, ts):
+        keys = mid_cols[self.key_pos]
+        cols, valid, ts2, ovf = exchange_by_key(
+            list(mid_cols), mask, ts, keys, self.n_shards, self.exchange_capacity
+        )
+        return cols, valid, ts2, ovf
+
+    def _local_keys(self, key_col):
+        return (key_col.astype(jnp.int32)) // self.n_shards
+
+    def _emission_keys(self):
+        idx = jax.lax.axis_index(AXIS).astype(jnp.int32)
+        return (
+            jnp.arange(self.local_key_capacity, dtype=jnp.int32) * self.n_shards
+            + idx
+        )
+
+    def _sharded_jit(self, state_spec_fn):
+        state = self.init_state()
+        state_specs = state_spec_fn(state)
+        in_specs = (
+            state_specs,
+            P(AXIS),  # cols (tuple leaves share the spec via tree prefix)
+            P(AXIS),  # valid
+            P(AXIS),  # ts
+            P(),      # wm_lower
+        )
+        # all emission leaves carry per-shard rows
+        out_specs = (state_specs, P(AXIS))
+        fn = jax.shard_map(
+            self._step,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+        )
+        return jax.jit(fn, donate_argnums=0)
+
+
+class ShardedWindowProgram(_ShardedMixin, WindowProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit(_state_specs)
+
+
+class ShardedRollingProgram(_ShardedMixin, RollingProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit(_rolling_state_specs)

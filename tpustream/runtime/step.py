@@ -55,6 +55,22 @@ class BaseProgram:
     def jitted_step(self):
         return jax.jit(self._step, donate_argnums=0)
 
+    # -- SPMD hooks: identity on one chip, mesh collectives when sharded --
+    n_shards = 1
+    vary_axes: tuple = ()
+
+    def _global_max(self, x):
+        return x
+
+    def _global_sum(self, x):
+        return x
+
+    def _exchange(self, mid_cols, mask, ts):
+        return mid_cols, mask, ts, jnp.zeros((), dtype=jnp.int64)
+
+    def _local_keys(self, key_col):
+        return key_col.astype(jnp.int32)
+
 
 class StatelessProgram(BaseProgram):
     """map/filter-only pipeline (reference chapter1 job, SURVEY.md §3.1)."""
@@ -109,28 +125,45 @@ class RollingProgram(BaseProgram):
 
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
-        keys = mid_cols[self.key_pos]
+        mid_cols, mask, ts, _ = self._exchange(mid_cols, mask, ts)
+        gkeys = mid_cols[self.key_pos]
+        keys = self._local_keys(gkeys)
         new_state, emitted = rolling_ops.rolling_step(
             state, keys, tuple(mid_cols), mask, self.combine
         )
         out_cols, out_mask = self.post_chain.apply(list(emitted), mask)
         n_shards = max(1, self.cfg.parallelism)
-        subtask = (keys.astype(jnp.int32) % n_shards)
+        subtask = gkeys.astype(jnp.int32) % n_shards
         return new_state, {
             "main": {"mask": out_mask, "cols": tuple(out_cols), "subtask": subtask}
         }
 
 
 def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
+    sharded = cfg.parallelism > 1
     if plan.stateful is None:
         return StatelessProgram(plan, cfg)
     if plan.stateful.kind in ("rolling", "rolling_reduce"):
+        if sharded:
+            from .sharded import ShardedRollingProgram
+
+            return ShardedRollingProgram(plan, cfg)
         return RollingProgram(plan, cfg)
     if plan.stateful.kind == "window":
         if plan.stateful.apply_kind == "process":
+            if sharded:
+                raise NotImplementedError(
+                    "ProcessWindowFunction (host-evaluated full-window path) "
+                    "currently runs single-shard; use reduce/aggregate for "
+                    "sharded jobs"
+                )
             from .process_program import ProcessWindowProgram
 
             return ProcessWindowProgram(plan, cfg)
+        if sharded:
+            from .sharded import ShardedWindowProgram
+
+            return ShardedWindowProgram(plan, cfg)
         from .window_program import WindowProgram
 
         return WindowProgram(plan, cfg)

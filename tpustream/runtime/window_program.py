@@ -89,6 +89,10 @@ class WindowProgram(BaseProgram):
             self.allowed_lateness_ms,
             cfg.pane_ring_slack,
         )
+        # SPMD hooks: identity on a single chip, mesh collectives in the
+        # sharded subclass (key state sharded over the "shards" axis)
+        self.n_shards = 1
+        self.local_key_capacity = cfg.key_capacity
         self._build_agg()
         if self.apply_kind == "process":
             # post ops run on the host over user-collected results
@@ -180,6 +184,10 @@ class WindowProgram(BaseProgram):
     def _acc_dtype(self, kind: str):
         return np.int32 if kind == STR else NUMPY_DTYPES[kind]
 
+    # -- SPMD hooks (shared ones live on BaseProgram) -------------------
+    def _emission_keys(self):
+        return jnp.arange(self.local_key_capacity, dtype=jnp.int32)
+
     # ------------------------------------------------------------------
     def init_state(self):
         k, n = self.cfg.key_capacity, self.ring.n_slots
@@ -196,13 +204,14 @@ class WindowProgram(BaseProgram):
             "max_ts": jnp.asarray(W0, dtype=jnp.int64),
             "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
             "alert_overflow": jnp.zeros((), dtype=jnp.int64),
+            "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
         }
 
     # ------------------------------------------------------------------
     def _scatter_batch(self, state, keys, mid_cols, live, pane):
         """Merge the batch into the (key, pane) ring via sort + segmented
         scan with the user combiner (arrival order preserved)."""
-        k, n = self.cfg.key_capacity, self.ring.n_slots
+        k, n = self.local_key_capacity, self.ring.n_slots
         slot = jnp.mod(pane, n)
         cell = keys.astype(jnp.int64) * n + slot
         perm, sc, sv, seg_starts = sort_by_key(cell, live)
@@ -242,7 +251,7 @@ class WindowProgram(BaseProgram):
     # ------------------------------------------------------------------
     def _fire(self, state, acc, cnt, slot_pane, hi, wm_old, wm_new, touched_slot):
         ring = self.ring
-        k, n, f = self.cfg.key_capacity, ring.n_slots, ring.n_fire_candidates
+        k, n, f = self.local_key_capacity, ring.n_slots, ring.n_fire_candidates
         cand, ends, fire = pane_ops.fire_candidates(hi, wm_old, wm_new, ring)
         if self.allowed_lateness_ms > 0:
             # allowed-late arrivals re-fire already-fired windows they touch
@@ -265,12 +274,13 @@ class WindowProgram(BaseProgram):
 
         def do_fire(_):
             win_leaves, win_cnt = pane_ops.compose_windows(
-                acc, cnt, slot_pane, cand, ring, self.combine
+                acc, cnt, slot_pane, cand, ring, self.combine,
+                vary_axes=self.vary_axes,
             )
             results = self.finalize(tuple(win_leaves))  # leaves [K, F]
             emit_mask = fire[None, :] & (win_cnt > 0)   # [K, F]
             key_col = jnp.broadcast_to(
-                jnp.arange(k, dtype=jnp.int32)[:, None], (k, f)
+                self._emission_keys()[:, None], (k, f)
             )
             end_col = jnp.broadcast_to(ends[None, :], (k, f))
             # order fires by (window end, key): transpose to [F, K]
@@ -286,18 +296,19 @@ class WindowProgram(BaseProgram):
             return valid, out, overflow
 
         def no_fire(_):
+            v = lambda x: pane_ops.vary(x, self.vary_axes)
             zero_cols = [
-                jnp.zeros((cap,), dtype=self._acc_dtype(kd))
+                v(jnp.zeros((cap,), dtype=self._acc_dtype(kd)))
                 for kd in self.post_chain.out_kinds
             ]
             return (
-                jnp.zeros((cap,), dtype=bool),
+                v(jnp.zeros((cap,), dtype=bool)),
                 zero_cols
                 + [
-                    jnp.zeros((cap,), dtype=jnp.int32),
-                    jnp.zeros((cap,), dtype=jnp.int64),
+                    v(jnp.zeros((cap,), dtype=jnp.int32)),
+                    v(jnp.zeros((cap,), dtype=jnp.int64)),
                 ],
-                jnp.zeros((), dtype=jnp.int64),
+                v(jnp.zeros((), dtype=jnp.int64)),
             )
 
         return jax.lax.cond(any_fire, do_fire, no_fire, operand=None)
@@ -305,21 +316,24 @@ class WindowProgram(BaseProgram):
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
-        keys = mid_cols[self.key_pos].astype(jnp.int32)
         ring = self.ring
 
         wm_old = state["wm"]
-        batch_max = jnp.max(jnp.where(mask, ts, W0))
+        batch_max = self._global_max(jnp.max(jnp.where(mask, ts, W0)))
         new_max = jnp.maximum(state["max_ts"], batch_max)
         wm_new = jnp.maximum(
             wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
         )
 
+        # keyBy: route records to their key-owner shard (ICI all_to_all)
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+
         late = pane_ops.late_mask(ts, wm_old, self.allowed_lateness_ms, ring) & mask
         live = mask & ~late
 
         pane = pane_ops.pane_of(ts, ring.pane_ms)
-        batch_hi = jnp.max(jnp.where(live, pane, -1))
+        batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
         init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
@@ -344,8 +358,14 @@ class WindowProgram(BaseProgram):
             "hi": hi,
             "wm": wm_new,
             "max_ts": new_max,
-            "evicted_unfired": state["evicted_unfired"] + evicted,
-            "alert_overflow": state["alert_overflow"] + overflow,
+            "evicted_unfired": state["evicted_unfired"]
+            + self._global_sum(evicted),
+            "alert_overflow": state["alert_overflow"]
+            + self._global_sum(overflow),
+            "exchange_overflow": state.get(
+                "exchange_overflow", jnp.zeros((), dtype=jnp.int64)
+            )
+            + self._global_sum(xovf),
         }
         emissions = {
             "main": {
