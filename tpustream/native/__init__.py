@@ -1,0 +1,184 @@
+"""ctypes bindings for the native fast parser.
+
+Builds ``_fastparse.so`` from ``fastparse.cpp`` on first use (g++ is in
+the image; pybind11 is not, so the binding is plain ctypes). Falls back
+gracefully: callers check ``available()`` and keep the numpy/python path
+when compilation fails.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+KIND_STR = 0
+KIND_F64 = 1
+KIND_I64 = 2
+KIND_ISO = 3
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_fastparse.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_HERE, "fastparse.cpp")
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", _SO],
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            os.path.join(_HERE, "fastparse.cpp")
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.tsp_table_new.restype = ctypes.c_void_p
+        lib.tsp_table_free.argtypes = [ctypes.c_void_p]
+        lib.tsp_table_size.argtypes = [ctypes.c_void_p]
+        lib.tsp_table_size.restype = ctypes.c_int64
+        lib.tsp_table_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.tsp_table_get.restype = ctypes.c_int64
+        lib.tsp_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.tsp_parse.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeTable:
+    """A C-side intern table mirrored into a Python StringTable.
+
+    Native ids are remapped to the Python table's ids after every parse,
+    so literals interned Python-side (e.g. by device-chain string
+    comparisons) and natively-parsed keys share one id namespace.
+    """
+
+    def __init__(self, py_table):
+        lib = _load()
+        self._lib = lib
+        self.ptr = lib.tsp_table_new()
+        self.py_table = py_table
+        self._remap: List[int] = []
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self._lib.tsp_table_free(self.ptr)
+        except Exception:
+            pass
+
+    def sync(self) -> np.ndarray:
+        """Extend the remap for newly-interned native ids; returns the
+        int32 remap array (native id -> python id)."""
+        lib = self._lib
+        n = lib.tsp_table_size(self.ptr)
+        if n > len(self._remap):
+            buf = ctypes.create_string_buffer(4096)
+            for i in range(len(self._remap), n):
+                ln = lib.tsp_table_get(self.ptr, i, buf, 4096)
+                s = buf.raw[: min(ln, 4096)].decode("utf-8", "replace")
+                self._remap.append(self.py_table.intern(s))
+        return np.asarray(self._remap, dtype=np.int32)
+
+
+class NativeParser:
+    """Parses a byte buffer of lines into columns per a base-field spec."""
+
+    def __init__(self, sep: str, specs, py_tables):
+        """specs: list of (field_idx, kind, tz_hours); py_tables aligned
+        (StringTable for KIND_STR outputs, else None)."""
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native parser unavailable")
+        self.sep = sep.encode()[0:1]
+        self.specs = list(specs)
+        n = len(self.specs)
+        self._field = (ctypes.c_int32 * n)(*[s[0] for s in self.specs])
+        self._kind = (ctypes.c_int32 * n)(*[s[1] for s in self.specs])
+        self._tz = (ctypes.c_int32 * n)(*[s[2] for s in self.specs])
+        self.tables: List[Optional[NativeTable]] = [
+            NativeTable(t) if s[1] == KIND_STR else None
+            for s, t in zip(self.specs, py_tables)
+        ]
+        self._tbl_ptrs = (ctypes.c_void_p * n)(
+            *[t.ptr if t is not None else None for t in self.tables]
+        )
+
+    def parse(self, data: bytes, max_rows: int):
+        n = len(self.specs)
+        cols = []
+        ptrs = (ctypes.c_void_p * n)()
+        for i, (fi, kind, tz) in enumerate(self.specs):
+            if kind == KIND_STR:
+                c = np.empty(max_rows, dtype=np.int32)
+            elif kind == KIND_F64:
+                c = np.empty(max_rows, dtype=np.float64)
+            else:
+                c = np.empty(max_rows, dtype=np.int64)
+            cols.append(c)
+            ptrs[i] = c.ctypes.data_as(ctypes.c_void_p)
+        bad = ctypes.c_int64(0)
+        rows = self._lib.tsp_parse(
+            data,
+            len(data),
+            self.sep,
+            n,
+            self._field,
+            self._kind,
+            self._tz,
+            self._tbl_ptrs,
+            ptrs,
+            max_rows,
+            ctypes.byref(bad),
+        )
+        out = []
+        for c, t in zip(cols, self.tables):
+            c = c[:rows]
+            if t is not None:
+                remap = t.sync()
+                c = remap[c] if len(remap) else c
+            out.append(c)
+        return out, int(bad.value)

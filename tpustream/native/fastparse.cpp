@@ -1,0 +1,206 @@
+// Fast columnar line parser for the host ingest path.
+//
+// The reference parses records inside per-record JVM MapFunctions
+// (split + Double.parseDouble, chapter1/.../Main.java:18-26; ISO-8601 +
+// UTC+8 epoch seconds, chapter3/.../BandwidthMonitorWithEventTime.java:32-34).
+// At the >=10M events/sec/chip target (BASELINE.json) host-side parsing
+// is the first bottleneck (SURVEY.md §7 "hard parts"), so the symbolic
+// parse plans compile down to this C++ kernel: one pass over a newline-
+// separated byte buffer, splitting on a single-byte separator and
+// materializing int64 / float64 / interned-string-id / iso8601-epoch
+// columns directly into caller-provided numpy buffers.
+//
+// Build: g++ -O3 -shared -fPIC fastparse.cpp -o _fastparse.so
+// (no external dependencies; ctypes-friendly C ABI).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Table {
+    std::unordered_map<std::string, int32_t> to_id;
+    std::vector<std::string> to_str;
+
+    int32_t intern(const char* s, size_t n) {
+        std::string key(s, n);
+        auto it = to_id.find(key);
+        if (it != to_id.end()) return it->second;
+        int32_t id = static_cast<int32_t>(to_str.size());
+        to_id.emplace(std::move(key), id);
+        to_str.emplace_back(s, n);
+        return id;
+    }
+};
+
+// Howard Hinnant's days-from-civil algorithm (public-domain formula).
+inline int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+    y -= m <= 2;
+    const int64_t era = (y >= 0 ? y : y - 399) / 400;
+    const int64_t yoe = y - era * 400;
+    const int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+    const int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return era * 146097 + doe - 719468;
+}
+
+inline bool parse2(const char* p, int64_t* out) {
+    if (p[0] < '0' || p[0] > '9' || p[1] < '0' || p[1] > '9') return false;
+    *out = (p[0] - '0') * 10 + (p[1] - '0');
+    return true;
+}
+
+// "YYYY-MM-DDTHH:MM:SS" (optionally more, ignored) -> epoch seconds,
+// interpreting the naive datetime at UTC+tz_hours (Java
+// LocalDateTime.toEpochSecond(ZoneOffset.ofHours(tz))).
+inline bool parse_iso(const char* s, size_t n, int tz_hours, int64_t* out) {
+    if (n < 19) return false;
+    int64_t y = 0;
+    for (int i = 0; i < 4; i++) {
+        if (s[i] < '0' || s[i] > '9') return false;
+        y = y * 10 + (s[i] - '0');
+    }
+    int64_t mo, d, h, mi, se;
+    if (s[4] != '-' || s[7] != '-' || (s[10] != 'T' && s[10] != ' ') ||
+        s[13] != ':' || s[16] != ':')
+        return false;
+    if (!parse2(s + 5, &mo) || !parse2(s + 8, &d) || !parse2(s + 11, &h) ||
+        !parse2(s + 14, &mi) || !parse2(s + 17, &se))
+        return false;
+    *out = days_from_civil(y, mo, d) * 86400 + h * 3600 + mi * 60 + se -
+           static_cast<int64_t>(tz_hours) * 3600;
+    return true;
+}
+
+inline int64_t parse_i64_tok(const char* s, size_t n) {
+    int64_t v = 0;
+    bool neg = false;
+    size_t i = 0;
+    if (n && (s[0] == '-' || s[0] == '+')) {
+        neg = s[0] == '-';
+        i = 1;
+    }
+    for (; i < n; i++) {
+        if (s[i] < '0' || s[i] > '9') break;
+        v = v * 10 + (s[i] - '0');
+    }
+    return neg ? -v : v;
+}
+
+inline double parse_f64_tok(const char* s, size_t n) {
+    char buf[64];
+    size_t m = n < 63 ? n : 63;
+    std::memcpy(buf, s, m);
+    buf[m] = '\0';
+    return std::strtod(buf, nullptr);
+}
+
+constexpr int KIND_STR = 0;
+constexpr int KIND_F64 = 1;
+constexpr int KIND_I64 = 2;
+constexpr int KIND_ISO = 3;
+
+}  // namespace
+
+extern "C" {
+
+Table* tsp_table_new() { return new Table(); }
+
+void tsp_table_free(Table* t) { delete t; }
+
+int64_t tsp_table_size(Table* t) {
+    return static_cast<int64_t>(t->to_str.size());
+}
+
+int64_t tsp_table_get(Table* t, int64_t idx, char* out, int64_t cap) {
+    if (idx < 0 || idx >= static_cast<int64_t>(t->to_str.size())) return -1;
+    const std::string& s = t->to_str[static_cast<size_t>(idx)];
+    int64_t n = static_cast<int64_t>(s.size());
+    if (n > cap) n = cap;
+    std::memcpy(out, s.data(), static_cast<size_t>(n));
+    return static_cast<int64_t>(s.size());
+}
+
+// Parse `len` bytes of newline-separated records.
+//   n_out columns, described by parallel arrays:
+//     field_idx[i]  separator-delimited token index
+//     kinds[i]      KIND_* above
+//     tz_hours[i]   timezone offset for KIND_ISO
+//     tables[i]     intern table for KIND_STR (else null)
+//     out_cols[i]   pre-allocated buffer: int32 (STR), double (F64),
+//                   int64 (I64/ISO), length >= max_rows
+// Returns the number of rows parsed (<= max_rows); *bad_lines counts rows
+// with missing/malformed tokens (their cells fill with 0 / id of "").
+int64_t tsp_parse(const char* buf, int64_t len, char sep, int32_t n_out,
+                  const int32_t* field_idx, const int32_t* kinds,
+                  const int32_t* tz_hours, Table** tables, void** out_cols,
+                  int64_t max_rows, int64_t* bad_lines) {
+    int32_t max_field = 0;
+    for (int32_t i = 0; i < n_out; i++)
+        if (field_idx[i] > max_field) max_field = field_idx[i];
+
+    std::vector<const char*> tok_start(static_cast<size_t>(max_field) + 1);
+    std::vector<size_t> tok_len(static_cast<size_t>(max_field) + 1);
+
+    int64_t row = 0;
+    int64_t bad = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end && row < max_rows) {
+        const char* nl = static_cast<const char*>(
+            std::memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* line_end = nl ? nl : end;
+        // tokenize up to max_field
+        int32_t nt = 0;
+        const char* q = p;
+        while (q <= line_end && nt <= max_field) {
+            const char* t = q;
+            while (q < line_end && *q != sep) q++;
+            tok_start[static_cast<size_t>(nt)] = t;
+            tok_len[static_cast<size_t>(nt)] = static_cast<size_t>(q - t);
+            nt++;
+            if (q < line_end) q++;  // skip separator
+            else break;
+        }
+        if (line_end > p) {  // skip empty lines entirely
+            bool row_bad = false;
+            for (int32_t i = 0; i < n_out; i++) {
+                int32_t fi = field_idx[i];
+                const char* ts = fi < nt ? tok_start[static_cast<size_t>(fi)] : "";
+                size_t tn = fi < nt ? tok_len[static_cast<size_t>(fi)] : 0;
+                if (fi >= nt) row_bad = true;
+                switch (kinds[i]) {
+                    case KIND_STR:
+                        static_cast<int32_t*>(out_cols[i])[row] =
+                            tables[i]->intern(ts, tn);
+                        break;
+                    case KIND_F64:
+                        static_cast<double*>(out_cols[i])[row] =
+                            tn ? parse_f64_tok(ts, tn) : 0.0;
+                        break;
+                    case KIND_I64:
+                        static_cast<int64_t*>(out_cols[i])[row] =
+                            tn ? parse_i64_tok(ts, tn) : 0;
+                        break;
+                    case KIND_ISO: {
+                        int64_t v = 0;
+                        if (!parse_iso(ts, tn, tz_hours[i], &v)) row_bad = true;
+                        static_cast<int64_t*>(out_cols[i])[row] = v;
+                        break;
+                    }
+                }
+            }
+            if (row_bad) bad++;
+            row++;
+        }
+        if (!nl) break;
+        p = nl + 1;
+    }
+    if (bad_lines) *bad_lines = bad;
+    return row;
+}
+
+}  // extern "C"

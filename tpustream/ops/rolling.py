@@ -81,7 +81,9 @@ def rolling_step(
 
     Returns (new_state, per-record emission columns in arrival order).
     """
-    perm, sk, sv, seg_starts = sort_by_key(keys, valid)
+    perm, sk, sv, seg_starts = sort_by_key(
+        keys, valid, max_key=state["seen"].shape[0]
+    )
     sorted_cols = tuple(c[perm] for c in cols)
 
     # within-batch inclusive per-key combine (arrival order preserved)
@@ -102,10 +104,10 @@ def rolling_step(
     tails = segment_tails(seg_starts) & sv
     idx = jnp.where(tails, sk, K).astype(jnp.int32)
     new_stored = tuple(
-        s.at[idx].set(e, mode="drop")
+        s.at[idx].set(e, mode="drop", unique_indices=True)
         for s, e in zip(state["stored"], emis_sorted)
     )
-    new_seen = state["seen"].at[idx].set(True, mode="drop")
+    new_seen = state["seen"].at[idx].set(True, mode="drop", unique_indices=True)
 
     inv = inverse_permutation(perm)
     emissions = tuple(e[inv] for e in emis_sorted)

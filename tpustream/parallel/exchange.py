@@ -32,11 +32,10 @@ def exchange_by_key(
     ``n_shards * capacity`` (records received by this shard).
     """
     b = valid.shape[0]
-    dest = jnp.where(valid, keys.astype(jnp.int64) % n_shards, n_shards)
+    dest = jnp.where(valid, keys.astype(jnp.int32) % n_shards, n_shards)
     pos = jnp.arange(b, dtype=jnp.int64)
-    composite = dest * b + pos
-    perm = jnp.argsort(composite)  # stable by construction (unique keys)
-    dest_s = dest[perm]
+    perm = jnp.argsort(dest, stable=True)
+    dest_s = dest[perm].astype(jnp.int64)
     valid_s = valid[perm]
     seg_starts = jnp.concatenate(
         [jnp.ones((1,), bool), dest_s[1:] != dest_s[:-1]]
@@ -53,14 +52,14 @@ def exchange_by_key(
         buf = jnp.zeros((n_shards * capacity,), dtype=col.dtype)
         return (
             buf.at[send_idx]
-            .set(col[perm], mode="drop")
+            .set(col[perm], mode="drop", unique_indices=True)
             .reshape(n_shards, capacity)
         )
 
     send_valid = (
         jnp.zeros((n_shards * capacity,), dtype=bool)
         .at[send_idx]
-        .set(fits, mode="drop")
+        .set(fits, mode="drop", unique_indices=True)
         .reshape(n_shards, capacity)
     )
 

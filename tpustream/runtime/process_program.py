@@ -109,7 +109,7 @@ class ProcessWindowProgram(WindowProgram):
         # ---- append batch elements to their cells ------------------------
         slot = jnp.mod(pane, n)
         cell = keys.astype(jnp.int64) * n + slot
-        perm, sc, sv, seg_starts = sort_by_key(cell, live)
+        perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=k * n)
         b = keys.shape[0]
         pos = jnp.arange(b, dtype=jnp.int64)
         seg_first = jax.lax.associative_scan(
@@ -123,21 +123,31 @@ class ProcessWindowProgram(WindowProgram):
         flat_idx = jnp.where(fits, cell_sorted * cap + write_pos, k * n * cap)
         sorted_cols = [c[perm] for c in mid_cols]
         buf = [
-            bb.reshape(-1).at[flat_idx].set(col, mode="drop").reshape(k, n, cap)
+            bb.reshape(-1)
+            .at[flat_idx]
+            .set(col, mode="drop", unique_indices=True)
+            .reshape(k, n, cap)
             for bb, col in zip(buf, sorted_cols)
         ]
         overflow = jnp.sum(sv & ~fits)
+        from ..ops.segments import segment_tails as _segtails
+
+        tails = _segtails(seg_starts) & sv
+        seg_count = (pos - seg_first + 1).astype(jnp.int32)
         cnt = (
             cnt.reshape(-1)
-            .at[jnp.where(live, cell, k * n)]
-            .add(jnp.ones_like(cell, dtype=jnp.int32), mode="drop")
+            .at[jnp.where(tails, jnp.clip(sc, 0, k * n - 1), k * n)]
+            .add(jnp.where(tails, seg_count, 0), mode="drop", unique_indices=True)
             .reshape(k, n)
         )
-        touched = (
-            jnp.zeros((n,), dtype=jnp.int32)
-            .at[jnp.where(live, slot, n)]
-            .add(1, mode="drop")
-        ) > 0
+        if self.allowed_lateness_ms > 0:
+            touched = (
+                jnp.zeros((n + 1,), dtype=jnp.int32)
+                .at[jnp.where(tails, jnp.mod(sc, n), n)]
+                .max(1, mode="drop")
+            )[:n] > 0
+        else:
+            touched = jnp.zeros((n,), dtype=bool)
 
         # ---- fire candidates --------------------------------------------
         cand, ends, fire = pane_ops.fire_candidates(hi, wm_old, wm_new, ring)

@@ -214,11 +214,21 @@ class WindowProgram(BaseProgram):
         k, n = self.local_key_capacity, self.ring.n_slots
         slot = jnp.mod(pane, n)
         cell = keys.astype(jnp.int64) * n + slot
-        perm, sc, sv, seg_starts = sort_by_key(cell, live)
+        perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=k * n)
         lifted = self.lift(list(mid_cols))
         lifted_sorted = tuple(l[perm] for l in lifted)
         prefix = segmented_scan(lifted_sorted, seg_starts, self.combine)
         tails = segment_tails(seg_starts) & sv
+
+        # every state write happens at SEGMENT TAILS — one unique index per
+        # touched cell — so XLA lowers to vectorized scatters instead of the
+        # serialized non-unique path (the TPU scatter trap)
+        b = sv.shape[0]
+        pos = jnp.arange(b, dtype=jnp.int64)
+        seg_first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(seg_starts, pos, 0)
+        )
+        seg_count = (pos - seg_first + 1).astype(jnp.int32)
 
         flat_idx = jnp.where(tails, sc, k * n)
         old_cnt_flat = state["cnt"].reshape(-1)
@@ -231,21 +241,28 @@ class WindowProgram(BaseProgram):
             jnp.where((old_cnt > 0) & sv, m, p) for m, p in zip(merged, prefix)
         )
         new_acc = [
-            a.reshape(-1).at[flat_idx].set(v, mode="drop").reshape(k, n)
+            a.reshape(-1)
+            .at[flat_idx]
+            .set(v, mode="drop", unique_indices=True)
+            .reshape(k, n)
             for a, v in zip(state["acc"], newvals)
         ]
-        # per-cell count increments (ones scatter-add; additive always)
-        add_idx = jnp.where(live, cell, k * n)
         new_cnt = (
-            old_cnt_flat.at[add_idx]
-            .add(jnp.ones_like(add_idx, dtype=jnp.int32), mode="drop")
+            old_cnt_flat.at[flat_idx]
+            .add(jnp.where(tails, seg_count, 0), mode="drop", unique_indices=True)
             .reshape(k, n)
         )
-        touched_slot = (
-            jnp.zeros((n,), dtype=jnp.int32)
-            .at[jnp.where(live, slot, n)]
-            .add(1, mode="drop")
-        ) > 0
+        if self.allowed_lateness_ms > 0:
+            # refire dirtiness needs exact touched-slot tracking
+            touched_slot = (
+                jnp.zeros((n + 1,), dtype=jnp.int32)
+                .at[jnp.where(tails, jnp.mod(sc, n), n)]
+                .max(1, mode="drop")
+            )[:n] > 0
+        else:
+            touched_slot = pane_ops.vary(
+                jnp.zeros((n,), dtype=bool), self.vary_axes
+            )
         return new_acc, new_cnt, touched_slot
 
     # ------------------------------------------------------------------
@@ -336,10 +353,26 @@ class WindowProgram(BaseProgram):
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
+        # ring retarget rewrites the whole [K, N] state, so gate it on an
+        # actual pane-boundary advance (most steps stay inside one pane)
         init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
-        acc, cnt, slot_pane, evicted = pane_ops.retarget(
-            state["acc"], state["cnt"], state["slot_pane"], hi, wm_old, ring,
-            init_leaves,
+
+        def do_retarget(_):
+            return pane_ops.retarget(
+                state["acc"], state["cnt"], state["slot_pane"], hi, wm_old,
+                ring, init_leaves,
+            )
+
+        def skip_retarget(_):
+            return (
+                list(state["acc"]),
+                state["cnt"],
+                state["slot_pane"],
+                pane_ops.vary(jnp.zeros((), dtype=jnp.int64), self.vary_axes),
+            )
+
+        acc, cnt, slot_pane, evicted = jax.lax.cond(
+            hi > state["hi"], do_retarget, skip_retarget, operand=None
         )
         acc, cnt, touched = self._scatter_batch(
             {"acc": acc, "cnt": cnt}, keys, mid_cols, live, pane
