@@ -1,0 +1,129 @@
+"""Checkpoint / resume (SURVEY.md §5; the reference's teased-but-unwritten
+checkpoint chapter, chapter3/README.md:454-456).
+
+Exactly-once contract under the deterministic replay source: a run
+restored from checkpoint k emits exactly the records the original run
+emitted after k — for keyed rolling state (ch2 max), windowed aggregation
+(ch2 avg), and event-time sliding windows (ch3).
+"""
+
+import glob
+import os
+
+import pytest
+
+from tpustream import StreamExecutionEnvironment, TimeCharacteristic
+from tpustream.config import StreamConfig
+from tpustream.runtime.checkpoint import load_checkpoint
+from tpustream.runtime.sources import AdvanceProcessingTime, ReplaySource
+
+
+def run_job(build, items, tmpdir=None, restore=None, time_char=None, **cfg):
+    cfg.setdefault("batch_size", 2)
+    if tmpdir is not None:
+        cfg["checkpoint_dir"] = str(tmpdir)
+        cfg["checkpoint_interval_batches"] = 1
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    if time_char is not None:
+        env.set_stream_time_characteristic(time_char)
+    if restore is not None:
+        env.restore_from_checkpoint(restore)
+    text = env.add_source(ReplaySource(items))
+    handle = build(env, text).collect()
+    env.execute("ckpt-test")
+    return handle.items
+
+
+def checkpoints(tmpdir):
+    return sorted(glob.glob(os.path.join(str(tmpdir), "ckpt-*.npz")))
+
+
+def resume_suffix_check(build, items, tmp_path, time_char=None, **cfg):
+    """Every surviving checkpoint must resume to the exact remaining
+    output suffix of an uninterrupted run."""
+    full = run_job(build, items, time_char=time_char, **cfg)
+    ckdir = tmp_path / "ck"
+    with_ck = run_job(build, items, tmpdir=ckdir, time_char=time_char, **cfg)
+    assert with_ck == full  # checkpointing must not perturb results
+    snaps = checkpoints(ckdir)
+    assert snaps, "no checkpoints were written"
+    for snap in snaps:
+        ck = load_checkpoint(snap)
+        resumed = run_job(
+            build, items, restore=snap, time_char=time_char, **cfg
+        )
+        assert resumed == full[ck.emitted :], (
+            f"resume from batch {ck.batches} (emitted={ck.emitted}) produced "
+            f"{resumed}, expected {full[ck.emitted:]}"
+        )
+    return full
+
+
+def test_rolling_max_resume(tmp_path):
+    from tpustream.jobs.chapter2_max import build
+
+    lines = [
+        "1563452056 10.8.22.1 cpu0 80.5",
+        "1563452050 10.8.22.1 cpu0 78.4",
+        "1563452056 10.8.22.2 cpu1 40.0",
+        "1563452060 10.8.22.1 cpu0 99.9",
+        "1563452061 10.8.22.2 cpu1 10.0",
+        "1563452062 10.8.22.1 cpu0 50.0",
+    ]
+    full = resume_suffix_check(build, lines, tmp_path)
+    # keyed rolling state survives: max re-emits 99.9 (not 50.0) post-resume
+    assert [r[2] for r in full] == [80.5, 80.5, 40.0, 99.9, 40.0, 99.9]
+
+
+def test_windowed_avg_resume(tmp_path):
+    from tpustream.jobs.chapter2_avg import build
+
+    items = [
+        "1563452056 10.8.22.1 cpu0 80.5",
+        "1563452050 10.8.22.1 cpu0 78.4",
+        "1563452056 10.8.22.1 cpu0 99.9",
+        "1563452056 10.8.22.2 cpu1 20.2",
+        AdvanceProcessingTime(61_000),
+        "1563452070 10.8.22.1 cpu0 10.0",
+        "1563452071 10.8.22.1 cpu0 20.0",
+        AdvanceProcessingTime(130_000),
+    ]
+    full = resume_suffix_check(build, items, tmp_path)
+    assert full == [86.26666666666667, 20.2, 15.0]
+
+
+def test_ch3_eventtime_sliding_resume(tmp_path):
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+
+    items = [
+        "2019-08-28T09:00:00 www.163.com 1000",
+        "2019-08-28T09:02:00 www.163.com 2000",
+        "2019-08-28T09:03:00 www.163.com 3000",
+        "2019-08-28T09:05:00 www.163.com 4000",
+        "2019-08-28T09:07:00 www.163.com 500",
+    ]
+    resume_suffix_check(
+        build, items, tmp_path, time_char=TimeCharacteristic.EventTime
+    )
+
+
+def test_restore_rejects_config_mismatch(tmp_path):
+    from tpustream.jobs.chapter2_max import build
+
+    lines = ["1563452056 10.8.22.1 cpu0 80.5", "1563452057 10.8.22.1 cpu0 90.0"]
+    ckdir = tmp_path / "ck"
+    run_job(build, lines, tmpdir=ckdir)
+    snap = checkpoints(ckdir)[0]
+    with pytest.raises(ValueError, match="does not match|state arrays"):
+        run_job(build, lines, restore=snap, key_capacity=2048)
+
+
+def test_load_latest_from_directory(tmp_path):
+    from tpustream.jobs.chapter2_max import build
+
+    lines = [f"1563452056 10.8.22.{i % 3} cpu0 {50 + i}.0" for i in range(6)]
+    ckdir = tmp_path / "ck"
+    full = run_job(build, lines, tmpdir=ckdir)
+    ck = load_checkpoint(str(ckdir))  # directory resolves to newest snapshot
+    resumed = run_job(build, lines, restore=str(ckdir))
+    assert resumed == full[ck.emitted :]

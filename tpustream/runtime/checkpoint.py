@@ -1,0 +1,196 @@
+"""Checkpoint / resume: snapshots of device-resident streaming state.
+
+The reference teases checkpointing as its unwritten next chapter
+(chapter3/README.md:454-456, "TaskManager crashes mid-window?"); SURVEY.md
+§5 specifies the TPU-native equivalent built here:
+
+* ``jax.device_get`` the whole device-state pytree — pane-accumulator
+  rings, rolling-aggregate slots, watermark / high-pane / overflow
+  scalars — into one ``.npz``,
+* alongside host-side stream position: lines consumed from the source,
+  the virtual processing-time clock, records emitted so far, and the
+  string-intern tables (so key ids keep meaning across restarts),
+* restore by re-placing every leaf onto the sharding of the program's
+  freshly built initial state (works for single-chip and mesh-sharded
+  programs alike) and skipping the already-consumed source lines.
+
+With the deterministic ``ReplaySource`` this gives exactly-once resume:
+a restored run emits exactly the records the original run had not yet
+emitted (tests/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+_META_KEY = "__meta__"
+
+
+def _leaves(state) -> List[np.ndarray]:
+    return [np.asarray(jax.device_get(l)) for l in jax.tree_util.tree_leaves(state)]
+
+
+@dataclass
+class Checkpoint:
+    """A loaded checkpoint: device-state leaves + host-side metadata."""
+
+    leaves: List[np.ndarray]
+    record_kinds: List[str]
+    tables: List[Optional[dict]]     # StringTable.state_dict() per column
+    source_pos: int                  # lines consumed from the source
+    proc_now: int                    # virtual processing-time clock (ms)
+    emitted: int                     # records emitted before this snapshot
+    batches: int
+    job_name: Optional[str] = None
+
+    def restore_state(self, program):
+        """Re-place the saved leaves onto ``program``'s init-state shardings.
+
+        Building the fresh initial state gives the target treedef, dtypes
+        and (for mesh-sharded programs) per-leaf shardings; a config or
+        job-graph mismatch surfaces as a structure/shape error here rather
+        than as silent corruption later.
+        """
+        target = program.init_state()
+        t_leaves, treedef = jax.tree_util.tree_flatten(target)
+        if len(t_leaves) != len(self.leaves):
+            raise ValueError(
+                f"checkpoint has {len(self.leaves)} state arrays but the "
+                f"program expects {len(t_leaves)} — job graph or config "
+                "changed since the snapshot"
+            )
+        placed = []
+        for saved, like in zip(self.leaves, t_leaves):
+            if tuple(saved.shape) != tuple(like.shape) or saved.dtype != like.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {saved.shape}/{saved.dtype} does not "
+                    f"match program state {like.shape}/{like.dtype} — "
+                    "key_capacity / batch_size / window config changed"
+                )
+            sharding = getattr(like, "sharding", None)
+            placed.append(
+                jax.device_put(saved, sharding) if sharding is not None else saved
+            )
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
+    def restore_tables(self, plan) -> None:
+        """Restore string-intern tables (and record kinds for adaptive
+        parse plans) so interned key ids keep their dense-slot meaning."""
+        from ..records import STR, StringTable
+
+        if not plan.record_kinds:
+            plan.record_kinds.extend(self.record_kinds)
+            plan.tables.extend(
+                StringTable() if k == STR else None for k in self.record_kinds
+            )
+        elif list(plan.record_kinds) != list(self.record_kinds):
+            raise ValueError(
+                f"checkpoint record kinds {self.record_kinds} != plan "
+                f"record kinds {plan.record_kinds}"
+            )
+        for table, saved in zip(plan.tables, self.tables):
+            if table is not None and saved is not None:
+                table.load_state_dict(saved)
+
+
+def save_checkpoint(
+    directory: str,
+    *,
+    state,
+    plan,
+    source_pos: int,
+    proc_now: int,
+    emitted: int,
+    batches: int,
+    job_name: Optional[str] = None,
+    keep: int = 3,
+) -> str:
+    """Snapshot to ``directory/ckpt-<batches>.npz`` (atomic rename); prunes
+    to the ``keep`` newest snapshots and refreshes ``latest`` marker."""
+    os.makedirs(directory, exist_ok=True)
+    meta = {
+        "version": FORMAT_VERSION,
+        "record_kinds": list(plan.record_kinds),
+        "tables": [
+            t.state_dict() if t is not None else None for t in plan.tables
+        ],
+        "source_pos": int(source_pos),
+        "proc_now": int(proc_now),
+        "emitted": int(emitted),
+        "batches": int(batches),
+        "job_name": job_name,
+    }
+    arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
+    name = f"ckpt-{batches:010d}.npz"
+    path = os.path.join(directory, name)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays, **{_META_KEY: np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8)})
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    with open(os.path.join(directory, "latest.tmp"), "w") as f:
+        f.write(name)
+    os.replace(
+        os.path.join(directory, "latest.tmp"), os.path.join(directory, "latest")
+    )
+    old = sorted(
+        n for n in os.listdir(directory)
+        if n.startswith("ckpt-") and n.endswith(".npz")
+    )
+    for n in old[:-keep]:
+        os.unlink(os.path.join(directory, n))
+    return path
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    marker = os.path.join(directory, "latest")
+    if os.path.exists(marker):
+        with open(marker) as f:
+            name = f.read().strip()
+        p = os.path.join(directory, name)
+        if os.path.exists(p):
+            return p
+    snaps = sorted(
+        n for n in os.listdir(directory)
+        if n.startswith("ckpt-") and n.endswith(".npz")
+    ) if os.path.isdir(directory) else []
+    return os.path.join(directory, snaps[-1]) if snaps else None
+
+
+def load_checkpoint(path: str) -> Checkpoint:
+    """Load an ``.npz`` snapshot (or the latest one in a directory)."""
+    if os.path.isdir(path):
+        p = latest_checkpoint(path)
+        if p is None:
+            raise FileNotFoundError(f"no checkpoint found in {path}")
+        path = p
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {meta.get('version')}")
+        names = sorted(k for k in z.files if k.startswith("L"))
+        leaves = [z[k] for k in names]
+    return Checkpoint(
+        leaves=leaves,
+        record_kinds=meta["record_kinds"],
+        tables=meta["tables"],
+        source_pos=meta["source_pos"],
+        proc_now=meta["proc_now"],
+        emitted=meta["emitted"],
+        batches=meta["batches"],
+        job_name=meta.get("job_name"),
+    )

@@ -30,6 +30,7 @@ from ..api.timeapi import TimeCharacteristic
 from .metrics import Metrics, Stopwatch
 from .plan import JobPlan, build_plan
 from .sinks import CollectSink, EmissionFormatter, FnSink, PrintSink
+from .sources import SourceBatch
 from .step import LONG_MIN, build_program
 
 
@@ -327,6 +328,22 @@ def execute_job(env, sink_nodes) -> JobResult:
     domain = plan.time_characteristic
     bounded = plan.source.is_bounded()
 
+    # -- checkpoint restore (chapter3/README.md:454-456 teased surface) ----
+    skip_lines = 0
+    restore_path = getattr(env, "_checkpoint_restore_path", None)
+    if restore_path:
+        from .checkpoint import load_checkpoint
+
+        ck = load_checkpoint(restore_path)
+        ck.restore_tables(plan)
+        runner = Runner(plan, cfg, metrics)
+        runner.state = ck.restore_state(runner.program)
+        skip_lines = ck.source_pos
+        proc_now = ck.proc_now
+    lines_consumed = skip_lines
+    ckpt_every = cfg.checkpoint_interval_batches
+    ckpt_enabled = bool(cfg.checkpoint_dir) and ckpt_every > 0
+
     def wm_lower_for_records(wm_hint: Optional[int]) -> int:
         if domain == TimeCharacteristic.ProcessingTime:
             return proc_now - 1
@@ -335,6 +352,14 @@ def execute_job(env, sink_nodes) -> JobResult:
         return LONG_MIN + 1
 
     for sb in plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms):
+        if skip_lines > 0 and sb.lines:
+            # resume: drop source lines the checkpointed run already consumed
+            take = min(skip_lines, len(sb.lines))
+            sb = SourceBatch(
+                sb.lines[take:], sb.proc_ts[take:], sb.advance_proc_to, sb.final
+            )
+            skip_lines -= take
+        lines_consumed += len(sb.lines)
         with Stopwatch() as hw:
             batch, wm_hint = host.process(sb.lines, sb.proc_ts)
         metrics.host_times_s.append(hw.elapsed)
@@ -353,6 +378,23 @@ def execute_job(env, sink_nodes) -> JobResult:
             and domain == TimeCharacteristic.ProcessingTime
         ):
             runner.flush(proc_now - 1)
+        if (
+            ckpt_enabled
+            and runner is not None
+            and metrics.batches % ckpt_every == 0
+        ):
+            from .checkpoint import save_checkpoint
+
+            save_checkpoint(
+                cfg.checkpoint_dir,
+                state=runner.state,
+                plan=plan,
+                source_pos=lines_consumed,
+                proc_now=proc_now,
+                emitted=metrics.records_emitted,
+                batches=metrics.batches,
+                job_name=env.job_name,
+            )
         if sb.final:
             break
 
