@@ -3,20 +3,18 @@
 
 Measures the BASELINE.json north-star metric — sustained events/sec/chip
 on the flagship job (5-min/5-s sliding windows, 1M keys, bounded
-out-of-orderness watermarks, late-drop, Mbps alert filter) — plus p99
-ingest->alert latency, native parse throughput, and the tunnel-bound
-end-to-end rate as detail.
+out-of-orderness watermarks, out-of-order arrivals, Mbps alert filter) —
+plus p99 ingest->alert latency and native parse throughput.
 
-Phases:
-  A. device pipeline: batches generated on device (modeling a DMA'd
-     ingest path); the full jitted job step chains state across steps.
-  B. alert latency: steps that cross slide boundaries fire windows; time
-     from batch submit to alerts materialized on host (plus modeled
-     batch residency at the measured rate).
-  C. native C++ parse throughput on the ch3 line format.
-  D. transfer-inclusive rate through this environment's TPU tunnel
-     (detail only: the tunnel is an environment artifact, ~40 MB/s with
-     ~100 ms RPC latency vs PCIe on a real v5e host).
+Methodology: the stream is generated ON DEVICE at a fixed intrinsic
+event-time rate (SIM_RATE = the 10M ev/s target), so pane advances and
+slide-boundary window fires happen at exactly the cadence a real
+10M ev/s stream induces; S steps are chained inside one jitted
+``lax.scan`` (state donated, nothing leaves the device) and timed
+wall-clock. This models the DMA'd-ingest deployment. The axon tunnel in
+this environment adds ~100 ms RPC latency and ~40 MB/s bandwidth per
+host<->device crossing, which a real v5e host does not have —
+tunnel-inclusive numbers go to stderr as detail.
 
 Prints ONE JSON line: metric/value/unit/vs_baseline. Detail -> stderr.
 """
@@ -32,6 +30,13 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+B = 1 << 17            # 131072 records/step
+K = 1 << 20            # 1M keys (BASELINE.json config 5)
+SIM_RATE = 10_000_000  # intrinsic stream rate: fires at real cadence
+BASE_MS = 1_566_957_600_000
+TARGET = 10_000_000    # north star: >= 10M events/s/chip
+
+
 def main():
     import importlib.util
 
@@ -44,90 +49,129 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    B = 1 << 17          # 131072 records/step
-    K = 1 << 20          # 1M keys (BASELINE.json config 5)
-    SIM_RATE = 20_000_000  # simulated ingest events/sec (ts advance)
-    BASE_MS = 1_566_957_600_000
-
     dev = jax.devices()[0]
-    log(f"device: {dev}, batch={B}, keys={K}")
+    log(f"device: {dev}, batch={B}, keys={K}, sim_rate={SIM_RATE/1e6:.0f}M ev/s")
 
+    t_build = time.perf_counter()
     program, cfg = ge._build_flagship(1, B, K)
-    step = jax.jit(program._step, donate_argnums=0)
-    ev_per_ms = SIM_RATE // 1000
+    wm0 = jnp.asarray(-(2**62), jnp.int64)
+    rec_per_ms = SIM_RATE // 1000
 
     def gen(i):
-        gidx = i * B + jnp.arange(B, dtype=jnp.int64)
-        h = gidx * 2654435761
+        """Batch i of the synthetic stream: uniform keys, ~1% alerting
+        (low-flow) channels, up to 10 s of bounded out-of-orderness."""
+        g = i * B + jnp.arange(B, dtype=jnp.int64)
+        h = g * 2654435761
         h = h ^ (h >> 29)
         keys = (h % K).astype(jnp.int32)
-        flow = (h >> 7) % 100_000 + 1
-        ts = BASE_MS + gidx // ev_per_ms
+        alerting = (keys & 127) == 0
+        flow = jnp.where(alerting, 1, 1_000_000)
+        jitter = (h >> 33) % 10_000
+        ts = BASE_MS + g // rec_per_ms - jitter
         return (ts // 1000, keys, flow), jnp.ones(B, bool), ts
 
-    wm0 = jnp.asarray(-(2**62), jnp.int64)
+    # separate generator and step dispatches (one jit each), exactly like
+    # the deployment host loop feeding pre-assembled batches. Fusing the
+    # generator INTO the step jit must be avoided: XLA then assigns
+    # mismatched layouts to the carried keyed state and relayouts the
+    # multi-GB acc arrays every step (~114 ms/step, a 1000x cliff);
+    # alert/late totals accumulate in a third tiny jit so nothing is
+    # fetched host-side inside the loop.
+    gen_j = jax.jit(gen)
+    step_j = jax.jit(program._step, donate_argnums=0)
 
-    def bench_step(state, i):
-        cols, valid, ts = gen(i)
-        return step(state, cols, valid, ts, wm0)
+    @jax.jit
+    def tally(tot, em):
+        a, l = tot
+        return (a + em["main"]["mask"].sum(), l + em["late"]["mask"].sum())
 
-    bench_step = jax.jit(bench_step, donate_argnums=0)
-
-    # ---- Phase A: device pipeline throughput -----------------------------
     state = program.init_state()
+    cols, valid, ts = gen_j(np.int64(0))
+    state, em = step_j(state, cols, valid, ts, wm0)
+    tot = tally((jnp.asarray(0, jnp.int64), jnp.asarray(0, jnp.int64)), em)
+    jax.block_until_ready(tot)
+    log(f"build + compile + first step: {time.perf_counter()-t_build:.1f}s")
+
+    # warm through the watermark delay so slide fires happen in the timed
+    # region: first window end fires at ~(delay + slide) of stream time
+    WARM = 5_400  # * 13.1 ms/step ≈ 71 s of stream
     t0 = time.perf_counter()
-    state, em = bench_step(state, jnp.asarray(0, jnp.int64))
-    jax.block_until_ready(em["main"]["mask"])
-    compile_s = time.perf_counter() - t0
-    log(f"compile + first step: {compile_s:.1f}s")
-
-    # warmup through a few slide crossings so the fire path is compiled+hot
-    for i in range(1, 6):
-        state, em = bench_step(state, jnp.asarray(i, jnp.int64))
-    jax.block_until_ready(em["main"]["mask"])
-
-    n_steps = 120
-    start_i = 6
-    t0 = time.perf_counter()
-    for i in range(start_i, start_i + n_steps):
-        state, em = bench_step(state, jnp.asarray(i, jnp.int64))
-    jax.block_until_ready(em["main"]["mask"])
-    dt = time.perf_counter() - t0
-    rate = B * n_steps / dt
-    log(
-        f"phase A: {n_steps} steps, {dt:.3f}s -> "
-        f"{rate/1e6:.1f}M events/s/chip ({dt/n_steps*1000:.2f} ms/step)"
-    )
-    fired = int(np.asarray(em["main"]["mask"]).sum())
-    log(f"  (last step emitted {fired} alerts; wm advanced "
-        f"{int(np.asarray(state['wm']) - BASE_MS)} ms of event time)")
-
-    # ---- Phase B: alert latency ------------------------------------------
-    # fires happen when the watermark crosses a 5s slide boundary; at
-    # SIM_RATE that is every 100M events. Measure submit->alerts-on-host.
-    lat = []
-    i = start_i + n_steps
-    residency_ms = B / rate * 1000.0
-    fires_seen = 0
-    while fires_seen < 12 and i < start_i + n_steps + 2000:
-        t0 = time.perf_counter()
-        state, em = bench_step(state, jnp.asarray(i, jnp.int64))
-        mask = np.asarray(em["main"]["mask"])  # forces device->host fetch
-        dt_ms = (time.perf_counter() - t0) * 1000.0
-        if mask.any():
-            np.asarray(em["main"]["cols"][0])
-            fires_seen += 1
-            lat.append(residency_ms + dt_ms)
+    i = 1
+    for _ in range(WARM):
+        cols, valid, ts = gen_j(np.int64(i))
+        state, em = step_j(state, cols, valid, ts, wm0)
+        tot = tally(tot, em)
         i += 1
-    lat_arr = np.asarray(lat) if lat else np.asarray([float("nan")])
-    p99 = float(np.percentile(lat_arr, 99))
+    jax.block_until_ready(tot)
     log(
-        f"phase B: {fires_seen} firing steps, alert latency "
-        f"median {np.median(lat_arr):.1f} ms, p99 {p99:.1f} ms "
-        f"(incl. {residency_ms:.1f} ms batch residency)"
+        f"warmup: {WARM} steps in {time.perf_counter()-t0:.1f}s, "
+        f"wm at {int(state['wm'] - BASE_MS)} ms of stream, "
+        f"{int(tot[0])} alerts so far"
     )
 
-    # ---- Phase C: native parse throughput --------------------------------
+    # ---- Phase A: sustained device throughput ---------------------------
+    S = 5_000  # 65 s of stream: ~13 slide fires at their real cadence
+    a0, l0 = int(tot[0]), int(tot[1])
+    t0 = time.perf_counter()
+    for _ in range(S):
+        cols, valid, ts = gen_j(np.int64(i))
+        state, em = step_j(state, cols, valid, ts, wm0)
+        tot = tally(tot, em)
+        i += 1
+    jax.block_until_ready(tot)
+    dt = time.perf_counter() - t0
+    total_alerts = int(tot[0]) - a0
+    total_late = int(tot[1]) - l0
+    events = S * B
+    rate = events / dt
+    stream_s = events / SIM_RATE
+    i0 = np.int64(i)
+    alert_ovf = int(state["alert_overflow"])
+    evicted = int(state["evicted_unfired"])
+    log(
+        f"phase A: {S} steps ({events/1e6:.0f}M events, "
+        f"{stream_s:.1f}s of stream) in {dt:.3f}s -> "
+        f"{rate/1e6:.2f}M events/s/chip ({dt/S*1e3:.3f} ms/step); "
+        f"{total_alerts} alerts, {total_late} late-dropped, "
+        f"{alert_ovf} overflowed, {evicted} evicted-unfired"
+    )
+
+    # ---- Phase B: ingest -> alert latency -------------------------------
+    # drive a step whose watermark crosses the next slide boundary (the
+    # wm_lower hint models a processing-time tick): windows fire, alerts
+    # are compacted on device, and we time submit -> alerts on host.
+    # Tunnel RTT (~100+ ms here) is an environment artifact; deployment
+    # p99 = firing-step device time + batch residency, alerts over PCIe.
+    step_nd = jax.jit(program._step)
+    jax.block_until_ready(state)
+    cols, valid, ts = gen(i0)
+    wm_force = state["wm"] + 5_000  # next slide boundary crossed for sure
+    lat = []
+    em = None
+    for _ in range(30):
+        t1 = time.perf_counter()
+        _, em = step_nd(state, cols, valid, ts, wm_force)
+        np.asarray(em["main"]["mask"])
+        lat.append(time.perf_counter() - t1)
+    lat_ms = np.array(lat[5:]) * 1e3
+    fired = int(np.asarray(em["main"]["mask"]).sum())
+    residency_ms = B / SIM_RATE * 1e3
+    # tunnel RTT floor, measured with an empty round trip
+    t2 = time.perf_counter()
+    for _ in range(5):
+        np.asarray(jnp.zeros((), jnp.int32) + 1)
+    rtt_ms = (time.perf_counter() - t2) / 5 * 1e3
+    p99_raw = float(np.percentile(lat_ms, 99))
+    p99_tunnel = p99_raw + residency_ms
+    p99_dev = max(0.0, p99_raw - rtt_ms) + residency_ms
+    log(
+        f"phase B: firing step emits {fired} alerts; ingest->alert p99 "
+        f"{p99_dev:.1f} ms device-side (incl. {residency_ms:.1f} ms batch "
+        f"residency), {p99_tunnel:.1f} ms through this env's tunnel "
+        f"(RTT floor {rtt_ms:.1f} ms)"
+    )
+
+    # ---- Phase C: native parse throughput -------------------------------
     parse_rate = None
     try:
         from tpustream.hostparse import PlanEvaluator, trace_host_map
@@ -150,32 +194,25 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase C skipped: {e}")
 
-    # ---- Phase D: transfer-inclusive (tunnel) ----------------------------
-    try:
-        packed = np.zeros((B, 3), dtype=np.int64)
-        t0 = time.perf_counter()
-        n = 4
-        for j in range(n):
-            x = jax.device_put(packed, dev)
-        x.block_until_ready()
-        up_s = (time.perf_counter() - t0) / n
-        tunnel_rate = B / up_s
-        log(
-            f"phase D: packed upload {up_s*1000:.0f} ms/batch -> tunnel-bound "
-            f"{tunnel_rate/1e6:.2f}M events/s (environment artifact)"
-        )
-    except Exception as e:  # pragma: no cover
-        log(f"phase D skipped: {e}")
-
     print(
         json.dumps(
             {
                 "metric": "ch3 sliding-window events/sec/chip (device pipeline)",
                 "value": round(rate),
                 "unit": "events/s",
-                "vs_baseline": round(rate / 1e7, 3),
+                "vs_baseline": round(rate / TARGET, 3),
+                "detail": {
+                    "p99_alert_latency_ms_device": round(p99_dev, 2),
+                    "p99_alert_latency_ms_tunnel": round(p99_tunnel, 2),
+                    "alerts_emitted": total_alerts,
+                    "late_dropped": total_late,
+                    "alert_overflow": alert_ovf,
+                    "evicted_unfired": evicted,
+                    "native_parse_lines_per_s": round(parse_rate or 0),
+                },
             }
-        )
+        ),
+        flush=True,
     )
 
 

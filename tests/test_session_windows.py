@@ -1,0 +1,275 @@
+"""Session windows (gap-based merging windows).
+
+The reference documents sessions at chapter3/README.md:412-428: windows
+separated by >= gap of inactivity, firing when the watermark passes
+``last_ts + gap - 1``. These tests drive the TPU session program
+(tpustream/runtime/session_program.py) against a record-at-a-time oracle
+implementing exactly those semantics, in event time and processing time,
+single-chip and on the 8-virtual-device mesh.
+"""
+
+import numpy as np
+
+from tpustream import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple2,
+)
+from tpustream.api.windows import (
+    EventTimeSessionWindows,
+    ProcessingTimeSessionWindows,
+)
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import AdvanceProcessingTime, ReplaySource
+
+GAP_MS = 10_000
+DELAY_MS = 2_000
+
+
+def parse(value: str) -> Tuple2:
+    items = value.split(" ")
+    return Tuple2(items[1], int(items[2]))
+
+
+class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+    def __init__(self):
+        super().__init__(Time.milliseconds(DELAY_MS))
+
+    def extract_timestamp(self, value: str) -> int:
+        return int(value.split(" ")[0])
+
+
+def session_oracle(records, gap_ms=GAP_MS, delay_ms=DELAY_MS):
+    """Record-at-a-time Flink session semantics: per-key open sessions
+    merge on overlap; fire when watermark >= last_ts + gap - 1; a record
+    whose solo session has already closed is dropped as late."""
+    wm = -(2**62)
+    open_sessions = {}  # key -> list of [min_ts, max_ts, total]
+    out = []
+
+    def fire(new_wm):
+        for key in sorted(open_sessions):
+            keep = []
+            for s in sorted(open_sessions[key]):
+                if s[1] + gap_ms - 1 <= new_wm:
+                    out.append((key, s[2], s[1] + gap_ms))
+                else:
+                    keep.append(s)
+            open_sessions[key] = keep
+
+    for ts, key, v in records:
+        if ts + gap_ms - 1 <= wm:
+            continue  # late
+        sess = open_sessions.setdefault(key, [])
+        merged = [ts, ts, v]
+        rest = []
+        for s in sess:
+            if s[0] - gap_ms < merged[1] and merged[0] - gap_ms < s[1]:
+                merged = [
+                    min(merged[0], s[0]),
+                    max(merged[1], s[1]),
+                    merged[2] + s[2],
+                ]
+            else:
+                rest.append(s)
+        open_sessions[key] = rest + [merged]
+        wm = max(wm, ts - delay_ms)
+        fire(wm)
+    fire(2**62)  # bounded stream end
+    return sorted(out)
+
+
+def run_session_job(lines, batch_size=1, parallelism=1, key_capacity=64):
+    cfg = StreamConfig(
+        batch_size=batch_size,
+        key_capacity=key_capacity,
+        alert_capacity=1024,
+        parallelism=parallelism,
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines))
+    h = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    env.execute("SessionJob")
+    return sorted((t.f0, t.f1) for t in h.items)
+
+
+def lines_of(records):
+    return [f"{ts} {key} {v}" for ts, key, v in records]
+
+
+def test_single_session_fires_on_watermark():
+    # one burst, then a record far enough ahead to close it
+    recs = [
+        (1_000, "a", 1),
+        (4_000, "a", 2),
+        (9_000, "a", 4),
+        # 9_000 + 10_000 + DELAY -> watermark must pass 18_999
+        (25_000, "a", 8),
+    ]
+    got = run_session_job(lines_of(recs))
+    oracle = [(k, v) for k, v, _ in session_oracle(recs)]
+    assert got == sorted(oracle)
+    # first session is 1+2+4, second (EOS-fired) is 8
+    assert got == [("a", 7), ("a", 8)]
+
+
+def test_gap_splits_sessions_exactly():
+    recs = [
+        (0, "a", 1),
+        (9_999, "a", 2),     # gap 9999 < 10000: same session
+        (20_000, "a", 4),    # gap 10001 >= 10000: new session
+        (29_999, "a", 8),    # same as previous
+        (60_000, "a", 16),
+    ]
+    got = run_session_job(lines_of(recs))
+    assert got == [("a", 3), ("a", 12), ("a", 16)]
+
+
+def test_boundary_gap_exactly_equal_to_gap_splits():
+    recs = [(0, "a", 1), (10_000, "a", 2), (50_000, "a", 4)]
+    got = run_session_job(lines_of(recs))
+    # 10_000 - 0 == gap: NOT merged (windows [0,10000) and [10000,20000)
+    # touch but do not overlap in Flink)
+    assert got == [("a", 1), ("a", 2), ("a", 4)]
+
+
+def test_multiple_keys_independent_sessions():
+    recs = [
+        (0, "a", 1),
+        (1_000, "b", 10),
+        (5_000, "a", 2),
+        (30_000, "b", 20),
+        (31_000, "a", 4),
+    ]
+    got = run_session_job(lines_of(recs))
+    oracle = [(k, v) for k, v, _ in session_oracle(recs)]
+    assert got == sorted(oracle)
+    assert got == [("a", 3), ("a", 4), ("b", 10), ("b", 20)]
+
+
+def test_out_of_order_record_merges_sessions():
+    # two bursts >= gap apart are separate sessions; an out-of-order
+    # record lands between them while the first is still unfired
+    # (watermark 11_000 < 11_999) and bridges both into one session
+    recs = [
+        (0, "a", 1),
+        (2_000, "a", 2),
+        (13_000, "a", 4),   # separate session; wm -> 11_000, nothing fires
+        (7_000, "a", 8),    # bridges [0..2000] and [13000] into one
+        (60_000, "a", 16),
+    ]
+    got = run_session_job(lines_of(recs))
+    oracle = [(k, v) for k, v, _ in session_oracle(recs)]
+    assert got == sorted(oracle)
+    assert got == [("a", 15), ("a", 16)]
+
+
+def test_late_record_dropped():
+    recs = [
+        (0, "a", 1),
+        (50_000, "a", 2),   # wm -> 48_000; session [0,10000) fired
+        (5_000, "a", 4),    # ts+gap-1 = 14_999 <= 48_000: late, dropped
+        (90_000, "a", 8),
+    ]
+    got = run_session_job(lines_of(recs))
+    oracle = [(k, v) for k, v, _ in session_oracle(recs)]
+    assert got == sorted(oracle)
+    assert ("a", 4) not in got and ("a", 5) not in got
+
+
+def test_batched_matches_oracle_modulo_watermark_cadence():
+    # randomized stream, one batch per record -> exact oracle match
+    rng = np.random.default_rng(7)
+    t = 0
+    recs = []
+    for _ in range(200):
+        t += int(rng.integers(0, 15_000))
+        key = str(rng.choice(["a", "b", "c"]))
+        jitter = int(rng.integers(0, DELAY_MS))
+        recs.append((max(0, t - jitter), key, int(rng.integers(1, 100))))
+    got = run_session_job(lines_of(recs))
+    oracle = sorted((k, v) for k, v, _ in session_oracle(recs))
+    assert got == oracle
+
+
+def test_sharded_session_matches_single_chip():
+    rng = np.random.default_rng(3)
+    t = 0
+    recs = []
+    for _ in range(150):
+        t += int(rng.integers(0, 12_000))
+        key = str(rng.choice(["a", "b", "c", "d", "e"]))
+        recs.append((t, key, int(rng.integers(1, 50))))
+    single = run_session_job(lines_of(recs), batch_size=8)
+    sharded = run_session_job(
+        lines_of(recs), batch_size=8, parallelism=8, key_capacity=64
+    )
+    assert sharded == single
+
+
+def test_processing_time_sessions():
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=4, key_capacity=16, alert_capacity=64)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.ProcessingTime)
+    # a processing-time tick far past the gap closes the session
+    text = env.add_source(
+        ReplaySource(
+            ["x a 1", "x a 2", AdvanceProcessingTime(100_000)],
+            start_ms=1_000,
+            ms_per_record=100,
+        )
+    )
+    h = (
+        text.map(lambda v: Tuple2(v.split(" ")[1], int(v.split(" ")[2])))
+        .key_by(0)
+        .window(ProcessingTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    env.execute("ProcSession")
+    assert [(t.f0, t.f1) for t in h.items] == [("a", 3)]
+
+
+def test_session_aggregate_function():
+    from tpustream import AggregateFunction
+
+    class CountAgg(AggregateFunction):
+        def create_accumulator(self):
+            return Tuple2("", 0)
+
+        def add(self, value, accumulator):
+            return Tuple2(value.f0, accumulator.f1 + 1)
+
+        def get_result(self, accumulator):
+            return accumulator.f1
+
+        def merge(self, a, b):
+            return Tuple2(a.f0, a.f1 + b.f1)
+
+    recs = [(0, "a", 1), (3_000, "a", 1), (40_000, "a", 1)]
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=1, key_capacity=16, alert_capacity=64)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines_of(recs)))
+    h = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .aggregate(CountAgg())
+        .collect()
+    )
+    env.execute("SessionCount")
+    assert sorted(h.items) == [1, 2]

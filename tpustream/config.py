@@ -28,9 +28,18 @@ class StreamConfig:
     max_fires_per_step: Optional[int] = None  # default: pane ring length
     process_buffer_capacity: int = 128  # per-(key,pane) element buffer for
                                         # full-window process() functions
+    session_extra_panes: int = 48       # extra ring slots for session windows:
+                                        # bounds supported session length at
+                                        # ~(slack + extra) * gap
 
     # -- emission / alerts --------------------------------------------------
     alert_capacity: int = 65536       # compacted device->host alert slots/step
+    fire_capacity: Optional[int] = None  # fired (key, window) rows composed
+                                         # per step before the post-chain
+                                         # filter; None = key_capacity (one
+                                         # full slide wave). Overflow beyond
+                                         # either capacity is counted in
+                                         # state["alert_overflow"].
 
     # -- numerics -----------------------------------------------------------
     # float64 reproduces the reference's Java-double golden outputs exactly

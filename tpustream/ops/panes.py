@@ -131,71 +131,24 @@ def vary(x, axes):
     return jax.lax.pcast(x, axes, to="varying")
 
 
-def compose_windows(
-    acc_leaves,
-    cnt,
-    slot_pane,
-    cand,
-    spec: RingSpec,
-    combine: Callable,
-    vary_axes=(),
-):
-    """Fold each candidate window's panes in event-time order.
-
-    acc_leaves: list of [K, N]; cnt: [K, N]; cand: [F] last-pane ids.
-    Returns (win_leaves list of [K, F], win_cnt [K, F]).
-
-    Window counts are additive so they compose with one [N, F] matmul on
-    the MXU; generic accumulators fold with a P-step lax.scan of gathers
-    (panes ascending, so non-commutative combiners see event-time order).
-    """
-    n, f, p = spec.n_slots, spec.n_fire_candidates, spec.panes_per_window
-    # membership matrix: slot s (holding pane slot_pane[s]) belongs to
-    # candidate j iff its pane is one of the window's P panes
-    member = (slot_pane[:, None] <= cand[None, :]) & (
-        slot_pane[:, None] > (cand[None, :] - p)
-    )
-    mm = member.astype(cnt.dtype)
-    win_cnt = cnt @ mm  # [K, N] @ [N, F] on the MXU
-
-    # generic fold over panes, earliest first
-    def body(carry, o):
-        has, outs = carry
-        pane = cand - (p - 1) + o              # [F]
-        slot = jnp.mod(pane, n).astype(jnp.int32)
-        present = (slot_pane[slot] == pane) & (pane >= 0)  # slot holds pane
-        cell_cnt = cnt[:, slot]                # [K, F]
-        cell_present = present[None, :] & (cell_cnt > 0)
-        cells = [a[:, slot] for a in acc_leaves]
-        merged = combine(tuple(outs), tuple(cells))
-        new_outs = [
-            jnp.where(
-                cell_present & has, m, jnp.where(cell_present, c, o_)
-            )
-            for m, c, o_ in zip(merged, cells, outs)
-        ]
-        new_has = has | cell_present
-        return (new_has, new_outs), None
-
-    k = cnt.shape[0]
-    has0 = vary(jnp.zeros((k, f), dtype=bool), vary_axes)
-    outs0 = [
-        vary(jnp.zeros((k, f), dtype=a.dtype), vary_axes) for a in acc_leaves
-    ]
-    (has, outs), _ = jax.lax.scan(
-        body, (has0, outs0), jnp.arange(p, dtype=jnp.int64)
-    )
-    return outs, win_cnt
-
-
 def compact(mask_flat: jnp.ndarray, cols, capacity: int):
     """Device-side compaction: first `capacity` set rows of mask.
 
-    Returns (indices [A], count, overflow, gathered cols [A]).
+    Returns (indices [A], valid [A], overflow, gathered cols [A]).
+
+    Implemented as an int32 cumsum + searchsorted (the j-th set row is
+    the first position whose prefix count reaches j+1) rather than
+    ``jnp.nonzero``: with x64 enabled nonzero's internal cumsum runs in
+    emulated int64 — a pair-of-u32 prefix scan that blows the TPU's
+    scoped vmem on ~1e8-element masks.
     """
-    count = jnp.sum(mask_flat)
-    (idx,) = jnp.nonzero(mask_flat, size=capacity, fill_value=0)
-    out_cols = [c[idx] for c in cols]
-    valid = jnp.arange(capacity) < count
-    overflow = jnp.maximum(count - capacity, 0)
+    c = jnp.cumsum(mask_flat.astype(jnp.int32))
+    count = c[-1]
+    idx = jnp.searchsorted(
+        c, jnp.arange(1, capacity + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    idx = jnp.minimum(idx, mask_flat.shape[0] - 1)
+    out_cols = [x[idx] for x in cols]
+    valid = jnp.arange(capacity, dtype=jnp.int32) < count
+    overflow = jnp.maximum(count - capacity, 0).astype(jnp.int64)
     return idx, valid, overflow, out_cols

@@ -33,11 +33,17 @@ def sort_by_key(keys: jnp.ndarray, valid: jnp.ndarray, max_key: int = None):
     n = keys.shape[0]
     if max_key is not None and max_key < 2**31 - 1:
         k32 = jnp.where(valid, keys.astype(jnp.int32), jnp.int32(max_key))
+        # the barrier materializes the sort operand: without it XLA fuses
+        # whatever produced `keys` (e.g. an on-device generator or traced
+        # map chain) INTO the sort and recomputes it on every one of the
+        # O(log^2 n) bitonic passes — observed 500x slowdowns on v5e
+        k32 = jax.lax.optimization_barrier(k32)
         perm = jnp.argsort(k32, stable=True)
     else:
         pos = jnp.arange(n, dtype=jnp.int64)
         big = jnp.int64(1) << 40
         composite = jnp.where(valid, keys.astype(jnp.int64), big) * n + pos
+        composite = jax.lax.optimization_barrier(composite)
         perm = jnp.argsort(composite)
     sk = keys[perm]
     sv = valid[perm]

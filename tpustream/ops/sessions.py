@@ -1,0 +1,157 @@
+"""Session-window machinery: gap-based merging windows on the pane ring.
+
+The reference documents session windows (gap-separated activity bursts,
+chapter3/README.md:412-428) with the standard Flink semantics: every
+element opens a window ``[ts, ts+gap)``; overlapping windows merge; the
+merged window fires when the watermark passes ``last_ts + gap - 1``.
+
+TPU-native design: panes of exactly ``gap`` ms. Because two records in
+the same pane are < gap apart, and records in panes that are >= 2 apart
+are >= gap apart, *only adjacent occupied panes can merge*. Each ring
+cell therefore keeps, besides the user accumulator, the min and max
+record timestamp it has seen; a session is a maximal run of adjacent
+occupied panes whose boundary gaps ``min[o] - max[o-1]`` are < gap.
+Runs are reduced with segmented associative scans over the pane axis —
+no per-record loops, no dynamic shapes — and a fired run's cells are
+cleared so it never re-fires.
+
+Firing a run is safe (no later merge possible): any future record has
+``ts > wm >= session_max + gap - 1``, i.e. it cannot be within ``gap``
+of the fired session.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .panes import RingSpec, W0
+
+TS_MAX = 2**62  # empty-cell sentinel for per-cell min timestamp
+
+
+def seg_scan_axis0(values, absorb_prev, combine):
+    """Inclusive segmented scan along axis 0 of [O, ...] leaves.
+
+    ``absorb_prev[o]`` True means row o continues row o-1's segment.
+    ``values`` is a list of leaves whose axis 0 is O; trailing axes ride
+    along elementwise (absorb flags broadcast).
+    """
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        merged = combine(va, vb)
+        out = tuple(
+            jnp.where(_bcast(fb, x), m, x) for m, x in zip(merged, vb)
+        )
+        return (jnp.logical_and(fa, fb), out)
+
+    _, scanned = jax.lax.associative_scan(comb, (absorb_prev, tuple(values)))
+    return list(scanned)
+
+
+def _bcast(flag, x):
+    extra = x.ndim - flag.ndim
+    return flag.reshape(flag.shape + (1,) * extra)
+
+
+def propagate_to_run(fire_at_end: jnp.ndarray, link: jnp.ndarray) -> jnp.ndarray:
+    """Spread a run-end flag to every member of its run.
+
+    ``link[.., o]`` True means pane o belongs to the same run as o-1;
+    ``fire_at_end`` is nonzero only at run-end panes. Returns a mask that
+    is True on every pane of a fired run. Implemented as a reversed
+    segmented OR-scan (in reverse order a segment starts at a run end).
+    """
+    rf = jnp.flip(fire_at_end, axis=-1)
+    # reversed element r (original o = O-1-r) absorbs reversed r-1
+    # (original o+1) iff o+1 links back to o
+    rl = jnp.flip(link, axis=-1)
+    absorb = jnp.concatenate(
+        [jnp.zeros_like(rl[..., :1]), rl[..., :-1]], axis=-1
+    )
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa & fb, jnp.where(fb, va | vb, vb))
+
+    x = jnp.moveaxis(rf, -1, 0)
+    fl = jnp.moveaxis(absorb, -1, 0)
+    _, out = jax.lax.associative_scan(comb, (fl, x))
+    return jnp.flip(jnp.moveaxis(out, 0, -1), axis=-1)
+
+
+def session_runs(
+    occ: jnp.ndarray,      # [K, O] cell occupied (ascending pane order)
+    mn: jnp.ndarray,       # [K, O] per-cell min record ts
+    mx: jnp.ndarray,       # [K, O] per-cell max record ts
+    gap_ms: int,
+):
+    """Link/run structure of the ring in ascending pane order.
+
+    Returns (link [K,O], run_end [K,O]): ``link[:, o]`` true when pane o
+    merges with pane o-1; ``run_end`` marks the last pane of each run.
+    """
+    prev_occ = jnp.concatenate(
+        [jnp.zeros_like(occ[:, :1]), occ[:, :-1]], axis=1
+    )
+    prev_mx = jnp.concatenate(
+        [jnp.full_like(mx[:, :1], W0), mx[:, :-1]], axis=1
+    )
+    link = occ & prev_occ & (mn - prev_mx < gap_ms)
+    next_link = jnp.concatenate(
+        [link[:, 1:], jnp.zeros_like(link[:, :1])], axis=1
+    )
+    run_end = occ & ~next_link
+    return link, run_end
+
+
+def ascending_slot_order(hi_pane, ring: RingSpec):
+    """Ring slots reordered so panes ascend: returns (slot [O], pane_ids [O]).
+
+    Slot of pane p is ``p mod N``; the ring covers panes (hi-N, hi], so
+    the ascending order is a cyclic rotation of the slot axis.
+    """
+    n = ring.n_slots
+    o = jnp.arange(n, dtype=jnp.int64)
+    pane_ids = hi_pane - n + 1 + o
+    slot = jnp.mod(pane_ids, n).astype(jnp.int32)
+    return slot, pane_ids
+
+
+def session_retarget(
+    acc_leaves: List,
+    cnt,
+    cell_min,
+    cell_max,
+    slot_pane,
+    hi_pane,
+    wm,
+    gap_ms: int,
+    ring: RingSpec,
+    init_leaves: Sequence,
+):
+    """Advance the ring to (hi-N, hi]; stale slots are cleared.
+
+    A stale cell whose session end (``cell_max + gap``) had not yet fired
+    counts toward ``evicted_unfired`` (ring undersized for the session
+    length / lateness horizon).
+    """
+    from .panes import slot_targets
+
+    target = slot_targets(hi_pane, ring)
+    stale = slot_pane != target              # [N]
+    unfired_cell = stale[None, :] & (cnt > 0) & (cell_max + gap_ms - 1 > wm)
+    evicted = jnp.sum(jnp.where(unfired_cell, cnt, 0)).astype(jnp.int64)
+    cnt = jnp.where(stale[None, :], 0, cnt)
+    cell_min = jnp.where(stale[None, :], TS_MAX, cell_min)
+    cell_max = jnp.where(stale[None, :], W0, cell_max)
+    acc_leaves = [
+        jnp.where(stale[None, :], init, a)
+        for a, init in zip(acc_leaves, init_leaves)
+    ]
+    return acc_leaves, cnt, cell_min, cell_max, target, evicted

@@ -1,0 +1,271 @@
+"""Session windows as one jitted XLA program.
+
+Implements the gap-based merging windows the reference documents at
+chapter3/README.md:412-428 with reduce/aggregate window functions
+(the ``AggregateFunction.merge`` contract — which Flink only invokes on
+window merges, chapter2/README.md:144-147 — is exercised on every pane
+merge here).
+
+Design (see tpustream/ops/sessions.py): panes of exactly ``gap`` ms so
+only adjacent occupied panes can merge; each (key, pane) cell keeps the
+user accumulator plus min/max record timestamps; sessions are maximal
+linked runs reduced by segmented scans over the pane axis; a run fires
+when ``run_max_ts + gap - 1 <= watermark`` and its cells are cleared.
+
+Late records (``ts + gap - 1 <= watermark`` on arrival) are dropped to
+the late side output. This matches Flink except the corner where a late
+record would have merged into a still-open earlier session; sessions
+with ``allowed_lateness > 0`` are not supported (the reference only
+documents lateness for time windows, chapter3/README.md:209-228).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import panes as pane_ops
+from ..ops import sessions as sess_ops
+from ..ops.panes import W0
+from ..ops.sessions import TS_MAX
+from .plan import JobPlan
+from .window_program import WindowProgram
+
+
+class SessionWindowProgram(WindowProgram):
+    accepted_kinds = ("session",)
+
+    def __init__(self, plan: JobPlan, cfg):
+        st = plan.stateful
+        if st.apply_kind == "process":
+            raise NotImplementedError(
+                "session windows currently support reduce/aggregate window "
+                "functions (the surface the reference documents)"
+            )
+        if st.allowed_lateness_ms > 0:
+            raise NotImplementedError(
+                "allowed lateness on session windows is not supported; the "
+                "reference documents lateness for time windows only "
+                "(chapter3/README.md:209-228)"
+            )
+        super().__init__(plan, cfg)
+
+    # WindowProgram.__init__ builds the ring from spec.size/slide; give it
+    # a session-shaped ring instead: panes of gap ms, 1 pane per "window",
+    # extra slack so multi-pane sessions have room to grow.
+    def _make_ring(self, spec, cfg):
+        return pane_ops.make_ring_spec(
+            spec.gap_ms,
+            spec.gap_ms,
+            self.delay_ms,
+            0,
+            cfg.pane_ring_slack + cfg.session_extra_panes,
+        )
+
+    @property
+    def gap_ms(self) -> int:
+        return self.plan.stateful.window.gap_ms
+
+    # ------------------------------------------------------------------
+    def init_state(self):
+        state = super().init_state()
+        k, n = self.cfg.key_capacity, self.ring.n_slots
+        state["cell_min"] = jnp.full((k, n), TS_MAX, dtype=jnp.int64)
+        state["cell_max"] = jnp.full((k, n), W0, dtype=jnp.int64)
+        return state
+
+    # ------------------------------------------------------------------
+    def _scatter_session(self, state, keys, mid_cols, live, pane, ts):
+        """WindowProgram's tail-scatter, extended with two per-cell
+        min/max record-timestamp leaves (session boundary detection)."""
+        n_user = len(state["acc"])
+
+        def combine_ext(a, b):
+            ua = self.combine(a[:n_user], b[:n_user])
+            return tuple(ua) + (
+                jnp.minimum(a[n_user], b[n_user]),
+                jnp.maximum(a[n_user + 1], b[n_user + 1]),
+            )
+
+        batch_leaves = tuple(self.lift(list(mid_cols))) + (ts, ts)
+        leaves = list(state["acc"]) + [state["cell_min"], state["cell_max"]]
+        written, new_cnt, _, _ = self._scatter_cells(
+            leaves, state["cnt"], keys, batch_leaves, live, pane, combine_ext
+        )
+        return written[:-2], new_cnt, written[-2], written[-1]
+
+    # ------------------------------------------------------------------
+    def _fire_sessions(self, acc, cnt, cell_min, cell_max, slot_pane, hi, wm):
+        """Fire every completed session: returns (emit_valid, emit_cols,
+        overflow, clear_mask [K, N] in slot order)."""
+        ring = self.ring
+        k, n = self.local_key_capacity, ring.n_slots
+        cap = self.cfg.alert_capacity
+        # exact whenever K*N is small; bounded for huge-key jobs (see
+        # WindowProgram._fire)
+        fcap = self.cfg.fire_capacity or min(k * n, max(cap, 1 << 20))
+        slot, pane_ids = sess_ops.ascending_slot_order(hi, ring)
+
+        occ = (slot_pane[slot][None, :] == pane_ids[None, :]) & (cnt[:, slot] > 0)
+        mn = jnp.where(occ, cell_min[:, slot], TS_MAX)
+        mx = jnp.where(occ, cell_max[:, slot], W0)
+        link, run_end = sess_ops.session_runs(occ, mn, mx, self.gap_ms)
+        fire = run_end & (mx + self.gap_ms - 1 <= wm)
+        any_fire = jnp.any(fire)
+
+        def do_fire(_):
+            # inclusive segmented scans along the pane axis ([O, K] layout)
+            accs_o = [jnp.moveaxis(a[:, slot], 1, 0) for a in acc]  # [O, K]
+            cnt_o = jnp.moveaxis(cnt[:, slot], 1, 0)
+            absorb = jnp.moveaxis(link, 1, 0)                      # [O, K]
+
+            def comb(a, b):
+                ua = self.combine(tuple(a[:-1]), tuple(b[:-1]))
+                return tuple(ua) + (a[-1] + b[-1],)
+
+            scanned = sess_ops.seg_scan_axis0(
+                accs_o + [cnt_o], absorb, comb
+            )
+            sess_acc = [jnp.moveaxis(x, 0, 1) for x in scanned[:-1]]  # [K, O]
+            sess_cnt = jnp.moveaxis(scanned[-1], 0, 1)
+
+            emit_mask = fire & (sess_cnt > 0)
+            ends = mx + self.gap_ms                       # [K, O]
+
+            # compact fired sessions to fire_capacity rows first, so
+            # finalize and the (possibly f64) post chain run on <= fcap
+            # rows; then compact again on the post-filter mask so
+            # alert_capacity bounds alerts, not fired sessions
+            flat = lambda x: x.T.reshape(-1)              # pane-major
+            idx, fvalid, fire_ovf, _ = pane_ops.compact(
+                flat(emit_mask), [], fcap
+            )
+            o_idx = (idx // k).astype(jnp.int32)
+            k_idx = jnp.mod(idx, k).astype(jnp.int32)
+            results = self.finalize(
+                tuple(a[k_idx, o_idx] for a in sess_acc)
+            )                                             # leaves [fcap]
+            post_cols, post_mask = self.post_chain.apply(list(results), fvalid)
+            key_col = self._emission_keys()[k_idx]
+            end_col = ends[k_idx, o_idx]
+            _, valid, alert_ovf, out = pane_ops.compact(
+                post_mask & fvalid, post_cols + [key_col, end_col], cap
+            )
+            overflow = fire_ovf + alert_ovf
+            cleared = sess_ops.propagate_to_run(fire, link)  # [K, O]
+            # back to slot order: slot axis is a cyclic rotation of panes
+            inv = jnp.mod(
+                jnp.arange(n, dtype=jnp.int64) - (hi + 1), n
+            ).astype(jnp.int32)
+            clear_mask = cleared[:, inv]
+            return valid, out, overflow, clear_mask
+
+        def no_fire(_):
+            v = lambda x: pane_ops.vary(x, self.vary_axes)
+            zero_cols = [
+                v(jnp.zeros((cap,), dtype=self._acc_dtype(kd)))
+                for kd in self.post_chain.out_kinds
+            ]
+            return (
+                v(jnp.zeros((cap,), dtype=bool)),
+                zero_cols
+                + [
+                    v(jnp.zeros((cap,), dtype=jnp.int32)),
+                    v(jnp.zeros((cap,), dtype=jnp.int64)),
+                ],
+                v(jnp.zeros((), dtype=jnp.int64)),
+                v(jnp.zeros((k, n), dtype=bool)),
+            )
+
+        return jax.lax.cond(any_fire, do_fire, no_fire, operand=None)
+
+    # ------------------------------------------------------------------
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        ring = self.ring
+
+        wm_old = state["wm"]
+        batch_max = self._global_max(jnp.max(jnp.where(mask, ts, W0)))
+        new_max = jnp.maximum(state["max_ts"], batch_max)
+        wm_new = jnp.maximum(
+            wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
+        )
+
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+
+        # a record whose solo session has already closed is late
+        late = (ts + self.gap_ms - 1 <= wm_old) & mask
+        live = mask & ~late
+
+        pane = pane_ops.pane_of(ts, ring.pane_ms)
+        batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
+        hi = jnp.maximum(state["hi"], batch_hi)
+
+        init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
+
+        def do_retarget(_):
+            return sess_ops.session_retarget(
+                state["acc"], state["cnt"], state["cell_min"],
+                state["cell_max"], state["slot_pane"], hi, wm_old,
+                self.gap_ms, ring, init_leaves,
+            )
+
+        def skip_retarget(_):
+            return (
+                list(state["acc"]),
+                state["cnt"],
+                state["cell_min"],
+                state["cell_max"],
+                state["slot_pane"],
+                pane_ops.vary(jnp.zeros((), dtype=jnp.int64), self.vary_axes),
+            )
+
+        acc, cnt, cmin, cmax, slot_pane, evicted = jax.lax.cond(
+            hi > state["hi"], do_retarget, skip_retarget, operand=None
+        )
+        acc, cnt, cmin, cmax = self._scatter_session(
+            {"acc": acc, "cnt": cnt, "cell_min": cmin, "cell_max": cmax},
+            keys, mid_cols, live, pane, ts,
+        )
+
+        emit_valid, emit_cols, overflow, clear = self._fire_sessions(
+            acc, cnt, cmin, cmax, slot_pane, hi, wm_new
+        )
+        cnt = jnp.where(clear, 0, cnt)
+        cmin = jnp.where(clear, TS_MAX, cmin)
+        cmax = jnp.where(clear, W0, cmax)
+        acc = [
+            jnp.where(clear, init, a) for a, init in zip(acc, init_leaves)
+        ]
+
+        n_shards = max(1, self.cfg.parallelism)
+        key_out = emit_cols[-2]
+        new_state = {
+            "acc": acc,
+            "cnt": cnt,
+            "cell_min": cmin,
+            "cell_max": cmax,
+            "slot_pane": slot_pane,
+            "hi": hi,
+            "wm": wm_new,
+            "max_ts": new_max,
+            "evicted_unfired": state["evicted_unfired"]
+            + self._global_sum(evicted),
+            "alert_overflow": state["alert_overflow"]
+            + self._global_sum(overflow),
+            "exchange_overflow": state.get(
+                "exchange_overflow", jnp.zeros((), dtype=jnp.int64)
+            )
+            + self._global_sum(xovf),
+        }
+        emissions = {
+            "main": {
+                "mask": emit_valid,
+                "cols": tuple(emit_cols[:-2]),
+                "subtask": key_out % n_shards,
+                "window_end": emit_cols[-1],
+            },
+            "late": {"mask": late, "cols": tuple(mid_cols)},
+        }
+        return new_state, emissions

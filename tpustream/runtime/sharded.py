@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.exchange import exchange_by_key
 from ..parallel.mesh import AXIS, make_mesh
 from .plan import JobPlan
+from .session_program import SessionWindowProgram
 from .step import RollingProgram
 from .window_program import WindowProgram
 
@@ -119,6 +120,15 @@ class _ShardedMixin:
 
 
 class ShardedWindowProgram(_ShardedMixin, WindowProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit(_state_specs)
+
+
+class ShardedSessionWindowProgram(_ShardedMixin, SessionWindowProgram):
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         self._setup_sharding(cfg)

@@ -125,6 +125,10 @@ class SocketTextSource(Source):
         self._thread: Optional[threading.Thread] = None
 
     def _reader(self) -> None:
+        # lines are stamped with the wall clock AT READ TIME (Flink's
+        # source-assigned processing time): if the job stalls (e.g. the
+        # first jit compile), queued records keep their true arrival
+        # times instead of inheriting the post-stall clock
         try:
             with socket.create_connection((self.host, self.port)) as sock:
                 buf = b""
@@ -135,9 +139,15 @@ class SocketTextSource(Source):
                     buf += chunk
                     while b"\n" in buf:
                         line, buf = buf.split(b"\n", 1)
-                        self._queue.put(line.decode("utf-8", "replace").rstrip("\r"))
+                        self._queue.put(
+                            (line.decode("utf-8", "replace").rstrip("\r"),
+                             int(_time.time() * 1000))
+                        )
                 if buf:
-                    self._queue.put(buf.decode("utf-8", "replace").rstrip("\r"))
+                    self._queue.put(
+                        (buf.decode("utf-8", "replace").rstrip("\r"),
+                         int(_time.time() * 1000))
+                    )
         finally:
             self._queue.put(None)  # sentinel: EOF
 
@@ -147,6 +157,7 @@ class SocketTextSource(Source):
         done = False
         while not done:
             lines: List[str] = []
+            stamps: List[int] = []
             deadline = _time.monotonic() + max_delay_ms / 1000.0
             while len(lines) < batch_size:
                 timeout = deadline - _time.monotonic()
@@ -159,13 +170,14 @@ class SocketTextSource(Source):
                 if item is None:
                     done = True
                     break
-                lines.append(item)
+                lines.append(item[0])
+                stamps.append(item[1])
             now = int(_time.time() * 1000)
             # idle ticks still advance the processing-time clock so
             # processing-time windows fire without fresh input
             yield SourceBatch(
                 lines,
-                np.full(len(lines), now, dtype=np.int64),
+                np.asarray(stamps, dtype=np.int64),
                 advance_proc_to=now,
                 final=done,
             )

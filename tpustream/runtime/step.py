@@ -150,6 +150,14 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
             return ShardedRollingProgram(plan, cfg)
         return RollingProgram(plan, cfg)
     if plan.stateful.kind == "window":
+        if plan.stateful.window is not None and plan.stateful.window.kind == "session":
+            if sharded:
+                from .sharded import ShardedSessionWindowProgram
+
+                return ShardedSessionWindowProgram(plan, cfg)
+            from .session_program import SessionWindowProgram
+
+            return SessionWindowProgram(plan, cfg)
         if plan.stateful.apply_kind == "process":
             if sharded:
                 raise NotImplementedError(

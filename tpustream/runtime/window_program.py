@@ -15,9 +15,11 @@ Execution model per step (SURVEY.md §7):
   4. pane scatter: sort by (key, pane) cell, segmented associative scan
      with the user combiner, merge segment tails into the [K, N] ring,
   5. fire: statically-enumerated window-end candidates crossing the
-     watermark compose their panes (counts via MXU matmul, accumulators
-     via an event-time-ordered fold), results run the post chain and are
-     compacted on device to `alert_capacity` rows.
+     watermark; (key, window) occupancy via one MXU matmul; fired rows
+     are compacted FIRST (device-side nonzero to `alert_capacity` rows),
+     then composed pane-by-pane with the user combiner in event-time
+     order, finalized, and run through the post chain — so per-fire cost
+     scales with alerts emitted, not with keys x candidates.
 """
 
 from __future__ import annotations
@@ -53,11 +55,13 @@ def _dummy_scalar(kind: str):
 
 
 class WindowProgram(BaseProgram):
+    accepted_kinds = ("tumbling", "sliding")
+
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         st = plan.stateful
         spec = st.window
-        if not spec.is_time_window():
+        if spec.kind not in self.accepted_kinds:
             raise NotImplementedError(
                 f"{spec.kind} windows use a dedicated program"
             )
@@ -82,13 +86,7 @@ class WindowProgram(BaseProgram):
             # processing time: wm = max_proc_seen - 1 so a record at T
             # fires windows ending <= T (timer semantics)
             self.delay_ms = 1
-        self.ring = pane_ops.make_ring_spec(
-            spec.size_ms,
-            spec.slide_ms,
-            self.delay_ms,
-            self.allowed_lateness_ms,
-            cfg.pane_ring_slack,
-        )
+        self.ring = self._make_ring(spec, cfg)
         # SPMD hooks: identity on a single chip, mesh collectives in the
         # sharded subclass (key state sharded over the "shards" axis)
         self.n_shards = 1
@@ -105,6 +103,15 @@ class WindowProgram(BaseProgram):
             )
             self.out_kinds = self.post_chain.out_kinds
             self.out_tables = self.post_chain.out_tables
+
+    def _make_ring(self, spec, cfg):
+        return pane_ops.make_ring_spec(
+            spec.size_ms,
+            spec.slide_ms,
+            self.delay_ms,
+            self.allowed_lateness_ms,
+            cfg.pane_ring_slack,
+        )
 
     # ------------------------------------------------------------------
     # aggregation plumbing: lift / combine / finalize on leaf tuples
@@ -208,21 +215,24 @@ class WindowProgram(BaseProgram):
         }
 
     # ------------------------------------------------------------------
-    def _scatter_batch(self, state, keys, mid_cols, live, pane):
-        """Merge the batch into the (key, pane) ring via sort + segmented
-        scan with the user combiner (arrival order preserved)."""
+    def _scatter_cells(self, leaves, cnt, keys, batch_leaves, live, pane, combine):
+        """Merge a batch into the (key, pane) ring via sort + segmented
+        scan with ``combine`` (arrival order preserved).
+
+        ``leaves``: list of [K, N] state arrays; ``batch_leaves``: matching
+        [B] lifted batch values. Every state write happens at SEGMENT
+        TAILS — one unique index per touched cell — so XLA lowers to
+        vectorized scatters instead of the serialized non-unique path
+        (the TPU scatter trap). Returns (new_leaves, new_cnt, sc, tails).
+        """
         k, n = self.local_key_capacity, self.ring.n_slots
         slot = jnp.mod(pane, n)
         cell = keys.astype(jnp.int64) * n + slot
         perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=k * n)
-        lifted = self.lift(list(mid_cols))
-        lifted_sorted = tuple(l[perm] for l in lifted)
-        prefix = segmented_scan(lifted_sorted, seg_starts, self.combine)
+        lifted_sorted = tuple(l[perm] for l in batch_leaves)
+        prefix = segmented_scan(lifted_sorted, seg_starts, combine)
         tails = segment_tails(seg_starts) & sv
 
-        # every state write happens at SEGMENT TAILS — one unique index per
-        # touched cell — so XLA lowers to vectorized scatters instead of the
-        # serialized non-unique path (the TPU scatter trap)
         b = sv.shape[0]
         pos = jnp.arange(b, dtype=jnp.int64)
         seg_first = jax.lax.associative_scan(
@@ -231,26 +241,33 @@ class WindowProgram(BaseProgram):
         seg_count = (pos - seg_first + 1).astype(jnp.int32)
 
         flat_idx = jnp.where(tails, sc, k * n)
-        old_cnt_flat = state["cnt"].reshape(-1)
-        old_cnt = old_cnt_flat[jnp.clip(sc, 0, k * n - 1)]
-        olds = tuple(
-            a.reshape(-1)[jnp.clip(sc, 0, k * n - 1)] for a in state["acc"]
-        )
-        merged = self.combine(olds, prefix)
+        sc_c = jnp.clip(sc, 0, k * n - 1)
+        old_cnt_flat = cnt.reshape(-1)
+        old_cnt = old_cnt_flat[sc_c]
+        olds = tuple(a.reshape(-1)[sc_c] for a in leaves)
+        merged = combine(olds, prefix)
         newvals = tuple(
             jnp.where((old_cnt > 0) & sv, m, p) for m, p in zip(merged, prefix)
         )
-        new_acc = [
+        new_leaves = [
             a.reshape(-1)
             .at[flat_idx]
             .set(v, mode="drop", unique_indices=True)
             .reshape(k, n)
-            for a, v in zip(state["acc"], newvals)
+            for a, v in zip(leaves, newvals)
         ]
         new_cnt = (
             old_cnt_flat.at[flat_idx]
             .add(jnp.where(tails, seg_count, 0), mode="drop", unique_indices=True)
             .reshape(k, n)
+        )
+        return new_leaves, new_cnt, sc, tails
+
+    def _scatter_batch(self, state, keys, mid_cols, live, pane):
+        k, n = self.local_key_capacity, self.ring.n_slots
+        new_acc, new_cnt, sc, tails = self._scatter_cells(
+            state["acc"], state["cnt"], keys,
+            self.lift(list(mid_cols)), live, pane, self.combine,
         )
         if self.allowed_lateness_ms > 0:
             # refire dirtiness needs exact touched-slot tracking
@@ -288,29 +305,80 @@ class WindowProgram(BaseProgram):
         any_fire = jnp.any(fire)
 
         cap = self.cfg.alert_capacity
+        # exact (every fired (key, window) row composed) whenever K*F is
+        # small; bounded at >=1M rows for huge-key jobs, where steady-state
+        # fires (active keys x 1 slide) still fit and only bounded-stream
+        # EOS mass-fires can overflow (counted in alert_overflow)
+        fcap = self.cfg.fire_capacity or min(
+            self.local_key_capacity * f, max(cap, 1 << 20)
+        )
 
         def do_fire(_):
-            win_leaves, win_cnt = pane_ops.compose_windows(
-                acc, cnt, slot_pane, cand, ring, self.combine,
-                vary_axes=self.vary_axes,
+            # 1. occupancy of every (key, window) pair via one MXU matmul:
+            #    member[s, j] = slot s's pane belongs to candidate j
+            member = (slot_pane[:, None] <= cand[None, :]) & (
+                slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
+            )                                              # [N, F]
+            occ = (cnt > 0).astype(jnp.float32) @ member.astype(jnp.float32)
+            emit_mask = fire[None, :] & (occ > 0.5)        # [K, F]
+
+            # 2. compact occupied fired windows — (window end, key) order
+            #    via F-major flatten — to `fire_capacity` rows, so the
+            #    combine fold, finalize, and the (possibly f64) post chain
+            #    run on <= fcap rows, not K*F
+            flatT = lambda x: x.T.reshape(-1)
+            idx, fvalid, fire_ovf, _ = pane_ops.compact(
+                flatT(emit_mask), [], fcap
             )
-            results = self.finalize(tuple(win_leaves))  # leaves [K, F]
-            emit_mask = fire[None, :] & (win_cnt > 0)   # [K, F]
-            key_col = jnp.broadcast_to(
-                self._emission_keys()[:, None], (k, f)
+            f_idx = (idx // k).astype(jnp.int32)
+            k_idx = jnp.mod(idx, k).astype(jnp.int32)
+            cand_sel = cand[f_idx]                         # [fcap]
+
+            # 3. compose each selected window's panes in event-time order:
+            #    P gathers of [fcap] cells (earliest pane first, so
+            #    non-commutative reduce sees arrival-time order)
+            def body(carry, o):
+                has, outs = carry
+                pane_sel = cand_sel - (ring.panes_per_window - 1) + o
+                slot_sel = jnp.mod(pane_sel, n).astype(jnp.int32)
+                present = (
+                    (slot_pane[slot_sel] == pane_sel)
+                    & (pane_sel >= 0)
+                    & (cnt[k_idx, slot_sel] > 0)
+                    & fvalid
+                )
+                cells = [a[k_idx, slot_sel] for a in acc]
+                merged = self.combine(tuple(outs), tuple(cells))
+                new_outs = [
+                    jnp.where(
+                        present & has, m, jnp.where(present, c, o_)
+                    )
+                    for m, c, o_ in zip(merged, cells, outs)
+                ]
+                return (has | present, new_outs), None
+
+            v = lambda x: pane_ops.vary(x, self.vary_axes)
+            has0 = v(jnp.zeros((fcap,), dtype=bool))
+            outs0 = [v(jnp.zeros((fcap,), dtype=a.dtype)) for a in acc]
+            (_, outs), _ = jax.lax.scan(
+                body, (has0, outs0),
+                jnp.arange(ring.panes_per_window, dtype=jnp.int64),
             )
-            end_col = jnp.broadcast_to(ends[None, :], (k, f))
-            # order fires by (window end, key): transpose to [F, K]
-            flat = lambda x: x.T.reshape(-1)
-            cols = [flat(r) for r in results]
-            mask_flat = flat(emit_mask)
-            post_cols, post_mask = self.post_chain.apply(cols, mask_flat)
-            _, valid, overflow, out = pane_ops.compact(
-                post_mask,
-                post_cols + [flat(key_col), flat(end_col)],
+
+            results = self.finalize(tuple(outs))           # leaves [fcap]
+            post_cols, post_mask = self.post_chain.apply(list(results), fvalid)
+            key_col = self._emission_keys()[k_idx]
+            end_col = ends[f_idx]
+
+            # 4. compact again on the post-filter mask so `alert_capacity`
+            #    bounds ALERTS, not fired windows (a selective filter must
+            #    not have its survivors starved by non-alerting rows)
+            _, valid, alert_ovf, out = pane_ops.compact(
+                post_mask & fvalid,
+                post_cols + [key_col, end_col],
                 cap,
             )
-            return valid, out, overflow
+            return valid, out, fire_ovf + alert_ovf
 
         def no_fire(_):
             v = lambda x: pane_ops.vary(x, self.vary_axes)
