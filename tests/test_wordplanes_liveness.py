@@ -1,0 +1,203 @@
+"""Unit tests for the window-state storage layers added for TPU speed:
+int32 word-plane packing (ops/wordplanes.py), jaxpr liveness analysis
+(ops/liveness.py), and the scatter-reduce fast path's end-to-end
+equivalence with the exact sorted-merge path."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpustream.ops import liveness
+from tpustream.ops.wordplanes import pack_words, plane_dtypes, unpack_words
+
+
+def test_wordplane_roundtrip_exact():
+    rng = np.random.default_rng(0)
+    i64 = jnp.asarray(rng.integers(-(2**62), 2**62, 512))
+    f64 = jnp.asarray(rng.standard_normal(512) * 1e30)
+    s32 = jnp.asarray(rng.integers(0, 2**31 - 1, 512).astype(np.int32))
+    b = jnp.asarray(rng.integers(0, 2, 512).astype(bool))
+    kinds = ["i64", "f64", "str", "bool"]
+    words = pack_words([i64, f64, s32, b], kinds)
+    assert [w.dtype for w in words] == [
+        jnp.int32, jnp.int32, jnp.float64, jnp.int32, jnp.int32
+    ]
+    back = unpack_words(words, kinds)
+    assert np.array_equal(np.asarray(back[0]), np.asarray(i64))
+    assert np.array_equal(np.asarray(back[1]), np.asarray(f64))
+    assert np.array_equal(np.asarray(back[2]), np.asarray(s32))
+    assert np.array_equal(np.asarray(back[3]), np.asarray(b))
+
+
+def test_wordplane_compact32():
+    kinds = ["i64", "f64"]
+    assert [d.name for d in plane_dtypes(kinds, compact32=True)] == [
+        "int32", "float32"
+    ]
+    vals = [jnp.asarray([5, -7]), jnp.asarray([1.5, -2.25])]
+    words = pack_words(vals, kinds, compact32=True)
+    back = unpack_words(words, kinds, compact32=True)
+    assert np.array_equal(np.asarray(back[0]), [5, -7])
+    assert np.array_equal(np.asarray(back[1]), [1.5, -2.25])
+
+
+def test_liveness_fixpoint_and_passthrough():
+    # ch3-shaped reduce: f0 first-seen, f1 key passthrough, f2 summed;
+    # the post chain reads only (f1, f2)
+    def combine(a0, a1, a2, b0, b1, b2):
+        return (a0, a1, a2 + b2)
+
+    def result(a0, a1, a2):
+        return (a1, a2 * 8.0 / 60 / 1024 / 1024)
+
+    d = [
+        jnp.asarray(0, jnp.int64),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(0, jnp.int64),
+    ]
+    live = liveness.live_accumulator_leaves(result, combine, d, 3)
+    assert live == [False, True, True]
+    assert liveness.passthrough_outputs(combine, d + d, 3) == [
+        True, True, False
+    ]
+    assert liveness.leaf_algebraic_ops(combine, d, 3) == [
+        "first", "first", "add"
+    ]
+
+
+def test_liveness_closure_pulls_combiner_deps():
+    # the live output depends on a leaf the post chain never reads:
+    # closure must mark it live
+    def combine(a0, a1, b0, b1):
+        return (a0 + b0, a1 + b1 + b0)
+
+    def result(a0, a1):
+        return (a1,)
+
+    d = [jnp.asarray(0, jnp.int64), jnp.asarray(0, jnp.int64)]
+    live = liveness.live_accumulator_leaves(result, combine, d, 2)
+    assert live == [True, True]
+    # a1's combine is NOT a plain add of (a1, b1)
+    assert liveness.leaf_algebraic_ops(combine, d, 2) == ["add", None]
+
+
+def _build_ch3(acc_dtype):
+    from tpustream import StreamExecutionEnvironment, TimeCharacteristic
+    from tpustream.config import StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.runtime.plan import build_plan
+    from tpustream.runtime.sources import ReplaySource
+    from tpustream.runtime.step import build_program
+
+    cfg = StreamConfig(
+        batch_size=256, key_capacity=32, alert_capacity=128, acc_dtype=acc_dtype
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource([]))
+    build(env, text).collect()
+    plan = build_plan(env, env._sinks)
+    return build_program(plan, cfg)
+
+
+def test_fast_reduce_path_matches_exact_path():
+    progs = {d: _build_ch3(d) for d in ("float64", "int32")}
+    assert progs["int32"].fast_reduce and not progs["float64"].fast_reduce
+    # the flagship's liveness result: only the flow sum is stored
+    assert progs["int32"].stored_kinds == ["i64"]
+    assert progs["int32"].key_leaf == 1
+
+    base = 1_566_957_600_000
+    outs = {}
+    for d, prog in progs.items():
+        state = prog.init_state()
+        step = jax.jit(prog._step)
+        rng = np.random.default_rng(3)
+        rows = []
+        for it in range(25):
+            ts = base + it * 4000 + rng.integers(0, 9000, 256)
+            keys = rng.integers(0, 32, 256).astype(np.int32)
+            flow = rng.integers(1, 10_000, 256)
+            cols = (
+                jnp.asarray(ts // 1000),
+                jnp.asarray(keys),
+                jnp.asarray(flow),
+            )
+            state, em = step(
+                state,
+                cols,
+                jnp.ones(256, bool),
+                jnp.asarray(ts),
+                jnp.asarray(-(2**62), jnp.int64),
+            )
+            m = np.asarray(em["main"]["mask"])
+            for j in np.nonzero(m)[0]:
+                rows.append(
+                    (
+                        int(np.asarray(em["main"]["cols"][0])[j]),
+                        float(np.asarray(em["main"]["cols"][1])[j]),
+                        int(np.asarray(em["main"]["window_end"])[j]),
+                    )
+                )
+        outs[d] = sorted(rows)
+    assert outs["float64"] == outs["int32"]
+    assert len(outs["float64"]) > 0
+
+
+def test_deferred_fires_drain_in_order():
+    # budget 1 fire per step: a watermark jump spanning several slide
+    # boundaries must fire them one per step, in end order, and count
+    # the remainder in pending_fires
+    from tpustream import StreamExecutionEnvironment, TimeCharacteristic
+    from tpustream.config import StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.runtime.plan import build_plan
+    from tpustream.runtime.sources import ReplaySource
+    from tpustream.runtime.step import build_program
+
+    cfg = StreamConfig(
+        batch_size=64,
+        key_capacity=8,
+        alert_capacity=64,
+        max_fires_per_step=1,
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource([]))
+    build(env, text).collect()
+    plan = build_plan(env, env._sinks)
+    prog = build_program(plan, cfg)
+
+    base = 1_566_957_600_000
+    state = prog.init_state()
+    step = jax.jit(prog._step)
+    ts = np.full(64, base, np.int64)
+    cols = (
+        jnp.asarray(ts // 1000),
+        jnp.zeros(64, jnp.int32),
+        jnp.full(64, 100, jnp.int64),
+    )
+    wm_jump = jnp.asarray(base + 3 * 5_000 + 1, jnp.int64)
+    state, em = step(
+        state, cols, jnp.ones(64, bool), jnp.asarray(ts), wm_jump
+    )
+    ends = []
+    if int(np.asarray(em["main"]["mask"]).sum()):
+        ends.append(int(np.asarray(em["main"]["window_end"])[0]))
+    pending = int(np.asarray(state["pending_fires"]))
+    assert pending > 0
+    empty = (
+        jnp.zeros(64, jnp.int64),
+        jnp.zeros(64, jnp.int32),
+        jnp.zeros(64, jnp.int64),
+    )
+    for _ in range(pending + 1):
+        state, em = step(
+            state, empty, jnp.zeros(64, bool), jnp.zeros(64, jnp.int64), wm_jump
+        )
+        m = np.asarray(em["main"]["mask"])
+        if m.sum():
+            ends.append(int(np.asarray(em["main"]["window_end"])[0]))
+    assert int(np.asarray(state["pending_fires"])) == 0
+    assert ends == sorted(ends) and len(ends) >= 2

@@ -1,0 +1,140 @@
+"""Dead-column elimination for windowed accumulators.
+
+The reference runtime keeps whole records in window state (Flink buffers
+or accumulates every field of the reduced record). On TPU, every stored
+leaf is an HBM plane that must be scatter-updated per batch — the
+dominant per-step cost — so the planner prunes accumulator leaves that
+cannot influence any emission:
+
+* a leaf is LIVE if the post-window chain (finalize + maps/filters,
+  e.g. the Mbps conversion at reference
+  chapter3/.../BandwidthMonitorWithEventTime.java:48-55) reads it, and
+* liveness closes over the combiner: if a live combiner output reads a
+  leaf, that leaf is live too (fixpoint),
+* the key leaf of a ``reduce`` needs no storage at all when the combiner
+  passes it through verbatim — every record in a (key, pane) cell holds
+  the same key, so the fire path reconstructs it from the cell index.
+
+Dependence is decided on the traced jaxpr (sound: any syntactic use
+marks the input live), so user lambdas need no annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Set
+
+import jax
+import jax.extend.core
+
+
+def used_inputs(fn: Callable, dummies: Sequence) -> Set[int]:
+    """Indices of ``fn``'s positional args its outputs depend on.
+
+    Walks the closed jaxpr backwards from the output vars; any equation
+    producing a needed var marks all its variable inputs needed (calls
+    with subjaxprs are treated opaquely — conservative but sound).
+    """
+    closed = jax.make_jaxpr(fn)(*dummies)
+    jaxpr = closed.jaxpr
+    needed = {v for v in jaxpr.outvars if not isinstance(v, jax.extend.core.Literal)}
+    for eqn in reversed(jaxpr.eqns):
+        if any(v in needed for v in eqn.outvars):
+            for v in eqn.invars:
+                if not isinstance(v, jax.extend.core.Literal):
+                    needed.add(v)
+    return {i for i, v in enumerate(jaxpr.invars) if v in needed}
+
+
+def passthrough_outputs(fn: Callable, dummies: Sequence, arity: int) -> List[bool]:
+    """For a two-record combiner ``fn(a_leaves..., b_leaves...)`` returning
+    ``arity`` leaves: which output positions are literally one of the two
+    corresponding input leaves (out[i] is a[i] or b[i] in the jaxpr).
+
+    This is the syntactic guarantee that lets a key column be
+    reconstructed instead of stored (reference
+    chapter3/.../BandwidthMonitorWithEventTime.java:47 keeps ``v1.f1``)."""
+    closed = jax.make_jaxpr(fn)(*dummies)
+    jaxpr = closed.jaxpr
+    out = []
+    for i in range(arity):
+        ov = jaxpr.outvars[i]
+        a_var = jaxpr.invars[i]
+        b_var = jaxpr.invars[arity + i]
+        out.append(ov is a_var or ov is b_var)
+    return out
+
+
+def leaf_algebraic_ops(
+    combine_probe: Callable, dummies: Sequence, arity: int
+) -> List[str]:
+    """Per-output algebraic classification of a two-record combiner.
+
+    Returns one of ``"add" | "min" | "max" | "first" | None`` per leaf:
+    the output is a single commutative primitive applied to exactly the
+    two corresponding input leaves (or the verbatim a-side leaf for
+    ``first``). Detected syntactically on the jaxpr, so it is sound —
+    anything unrecognized falls back to the generic sorted-merge path.
+    Commutative leaves unlock the scatter-reduce fast path: XLA's
+    non-unique 32-bit scatter-add/min/max, with no sort, segmented scan,
+    or read-modify-write gathers per batch.
+    """
+    closed = jax.make_jaxpr(lambda *ab: combine_probe(*ab))(
+        *(list(dummies) + list(dummies))
+    )
+    jaxpr = closed.jaxpr
+    prim_names = {"add": "add", "min": "min", "max": "max"}
+    out: List[str] = []
+    for i in range(arity):
+        ov = jaxpr.outvars[i]
+        a_var = jaxpr.invars[i]
+        b_var = jaxpr.invars[arity + i]
+        if ov is a_var:
+            out.append("first")
+            continue
+        op = None
+        for eqn in jaxpr.eqns:
+            if any(v is ov for v in eqn.outvars):
+                name = prim_names.get(eqn.primitive.name)
+                ins = [v for v in eqn.invars if not isinstance(v, jax.extend.core.Literal)]
+                if (
+                    name is not None
+                    and len(ins) == 2
+                    and {id(ins[0]), id(ins[1])} == {id(a_var), id(b_var)}
+                ):
+                    op = name
+                break
+        out.append(op)
+    return out
+
+
+def live_accumulator_leaves(
+    result_probe: Callable,
+    combine_probe: Callable,
+    dummies: Sequence,
+    arity: int,
+) -> List[bool]:
+    """Fixpoint liveness over accumulator leaves.
+
+    ``result_probe(*leaves)`` maps accumulator leaves to everything that
+    escapes the window (post-chain outputs + filter predicates).
+    ``combine_probe(*a_leaves, *b_leaves)`` is the combiner on leaf pairs.
+    """
+    live = used_inputs(result_probe, dummies)
+    live = {i for i in live if i < arity}
+    # per-output dependence of the combiner
+    deps: List[Set[int]] = []
+    for i in range(arity):
+        def one_out(*ab, _i=i):
+            return combine_probe(*ab)[_i]
+
+        u = used_inputs(one_out, list(dummies) + list(dummies))
+        deps.append({j % arity for j in u})
+    changed = True
+    while changed:
+        changed = False
+        for i in list(live):
+            extra = deps[i] - live
+            if extra:
+                live |= extra
+                changed = True
+    return [i in live for i in range(arity)]

@@ -106,6 +106,23 @@ def retarget(acc_leaves, cnt, slot_pane, hi_pane, wm, spec: RingSpec, init_leave
     return acc_leaves, cnt, target, evicted
 
 
+def retarget_rows(plane_leaves, cnt, slot_pane, hi_pane, wm, spec: RingSpec, init_leaves):
+    """:func:`retarget` for slot-major ``[n_slots, keys]`` state planes
+    (the word-plane window layout): slots are ROWS, so stale slots clear
+    whole rows and the unfired count sums each stale row."""
+    target = slot_targets(hi_pane, spec)
+    stale = slot_pane != target
+    last_end = (slot_pane + spec.panes_per_window) * spec.pane_ms
+    unfired = stale & (last_end - 1 > wm)
+    evicted = jnp.sum(jnp.where(unfired, jnp.sum(cnt, axis=1), 0))
+    cnt = jnp.where(stale[:, None], 0, cnt)
+    plane_leaves = [
+        jnp.where(stale[:, None], init, p)
+        for p, init in zip(plane_leaves, init_leaves)
+    ]
+    return plane_leaves, cnt, target, evicted
+
+
 def fire_candidates(hi_pane, wm_old, wm_new, spec: RingSpec):
     """Static set of window-end candidates and which of them fire now.
 
@@ -136,19 +153,50 @@ def compact(mask_flat: jnp.ndarray, cols, capacity: int):
 
     Returns (indices [A], valid [A], overflow, gathered cols [A]).
 
-    Implemented as an int32 cumsum + searchsorted (the j-th set row is
-    the first position whose prefix count reaches j+1) rather than
-    ``jnp.nonzero``: with x64 enabled nonzero's internal cumsum runs in
-    emulated int64 — a pair-of-u32 prefix scan that blows the TPU's
-    scoped vmem on ~1e8-element masks.
+    Implemented as an int32 cumsum + position scatter of the row index,
+    then small gathers. The two obvious alternatives both fail on v5e:
+    ``jnp.nonzero``/``searchsorted`` run their prefix machinery in
+    emulated int64 (pair-of-u32 reduce-windows that exceed scoped vmem
+    at ~1e6 masks — verified compile failure), while scattering every
+    column directly pays the full-length scatter once per column instead
+    of once total.
     """
-    c = jnp.cumsum(mask_flat.astype(jnp.int32))
-    count = c[-1]
-    idx = jnp.searchsorted(
-        c, jnp.arange(1, capacity + 1, dtype=jnp.int32), side="left"
-    ).astype(jnp.int32)
-    idx = jnp.minimum(idx, mask_flat.shape[0] - 1)
+    idx, added = compact_positions(mask_flat, capacity)
+    count = added
     out_cols = [x[idx] for x in cols]
     valid = jnp.arange(capacity, dtype=jnp.int32) < count
     overflow = jnp.maximum(count - capacity, 0).astype(jnp.int64)
     return idx, valid, overflow, out_cols
+
+
+def compact_positions(mask_flat: jnp.ndarray, capacity: int, base: int = 0):
+    """The shared compaction core: scatter each set row's SOURCE index to
+    its output position ``base + rank``. Returns (idx [capacity], count)
+    where ``count`` is the total set rows (may exceed capacity)."""
+    c = jnp.cumsum(mask_flat.astype(jnp.int32))
+    count = c[-1]
+    n = mask_flat.shape[0]
+    pos = jnp.where(mask_flat, base + c - 1, capacity)  # past-cap rows drop
+    src = jnp.arange(n, dtype=jnp.int32)
+    idx = (
+        jnp.zeros((capacity,), dtype=jnp.int32)
+        .at[pos]
+        .set(src, mode="drop", unique_indices=True)
+    )
+    return idx, count
+
+
+def append_compact(mask_flat, src_cols, out_cols, count, capacity):
+    """Append the set rows of ``mask_flat`` after ``count`` existing rows
+    of the fixed ``[capacity]`` output columns. Returns
+    (out_cols, new_count, overflowed)."""
+    idx, added = compact_positions(mask_flat, capacity, base=count)
+    new_count = jnp.minimum(count + added, capacity)
+    ar = jnp.arange(capacity, dtype=jnp.int32)
+    in_new = (ar >= count) & (ar < new_count)
+    out_cols = [
+        jnp.where(in_new, s[idx].astype(o.dtype), o)
+        for o, s in zip(out_cols, src_cols)
+    ]
+    overflowed = jnp.maximum(count + added - capacity, 0).astype(jnp.int64)
+    return out_cols, new_count, overflowed

@@ -239,7 +239,11 @@ class Runner:
             self._dispatch(emissions)
 
     def flush(self, wm_lower: int):
-        """Advance time with an empty batch (processing-time tick / EOS)."""
+        """Advance time with an empty batch (processing-time tick / EOS).
+
+        Window programs fire at most ``max_fires_per_step`` window ends
+        per step (bounding fire-step latency); the loop here drains any
+        deferred ends until ``state["pending_fires"]`` reaches zero."""
         if self.plan.stateful is None or self.plan.stateful.kind in (
             "rolling",
             "rolling_reduce",
@@ -260,13 +264,19 @@ class Runner:
             ts = jnp.zeros((cfg.batch_size,), dtype=jnp.int64)
             self._empty_cache = (cols, valid, ts)
         cols, valid, ts = self._empty_cache
-        with Stopwatch() as sw:
-            self.state, emissions = self.step(
-                self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
-            )
-            emissions = jax.device_get(emissions)
-        self.metrics.step_times_s.append(sw.elapsed)
-        self._dispatch(emissions)
+        max_rounds = getattr(self.program, "ring", None)
+        max_rounds = (max_rounds.n_fire_candidates + 1) if max_rounds else 1
+        for _ in range(max_rounds):
+            with Stopwatch() as sw:
+                self.state, emissions = self.step(
+                    self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
+                )
+                emissions = jax.device_get(emissions)
+            self.metrics.step_times_s.append(sw.elapsed)
+            self._dispatch(emissions)
+            pending = self.state.get("pending_fires") if isinstance(self.state, dict) else None
+            if pending is None or int(jax.device_get(pending)) == 0:
+                break
 
     def _dispatch(self, emissions):
         fire_info = emissions.get("process_fire")

@@ -68,11 +68,34 @@ class SessionWindowProgram(WindowProgram):
 
     # ------------------------------------------------------------------
     def init_state(self):
-        state = super().init_state()
+        # sessions keep the typed [keys, slots] cell layout (they need
+        # per-cell min/max timestamps and full-typed segmented merges,
+        # not the time-window word-plane fast path)
         k, n = self.cfg.key_capacity, self.ring.n_slots
-        state["cell_min"] = jnp.full((k, n), TS_MAX, dtype=jnp.int64)
-        state["cell_max"] = jnp.full((k, n), W0, dtype=jnp.int64)
-        return state
+        hi0 = jnp.asarray(-1, dtype=jnp.int64)
+        return {
+            "acc": [
+                jnp.zeros((k, n), dtype=self._acc_dtype(kd))
+                for kd in self.acc_kinds
+            ],
+            "cnt": jnp.zeros((k, n), dtype=jnp.int32),
+            "slot_pane": pane_ops.slot_targets(hi0, self.ring),
+            "hi": hi0,
+            "wm": jnp.asarray(W0, dtype=jnp.int64),
+            "max_ts": jnp.asarray(W0, dtype=jnp.int64),
+            "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
+            "alert_overflow": jnp.zeros((), dtype=jnp.int64),
+            "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
+            "cell_min": jnp.full((k, n), TS_MAX, dtype=jnp.int64),
+            "cell_max": jnp.full((k, n), W0, dtype=jnp.int64),
+        }
+
+    def state_specs(self, state):
+        # typed [K, N] cells shard on the KEY axis (axis 0), unlike the
+        # word-plane layout of WindowProgram
+        from .step import BaseProgram
+
+        return BaseProgram.state_specs(self, state)
 
     # ------------------------------------------------------------------
     def _scatter_session(self, state, keys, mid_cols, live, pane, ts):

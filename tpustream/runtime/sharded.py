@@ -27,25 +27,6 @@ from .step import RollingProgram
 from .window_program import WindowProgram
 
 
-def _state_specs(state) -> Any:
-    """Arrays with a key axis (ndim >= 2 or bool/field [K] vectors) shard on
-    axis 0; ring metadata and scalars replicate."""
-
-    def spec(leaf):
-        if leaf.ndim >= 2:
-            return P(AXIS)
-        return P()
-
-    return jax.tree_util.tree_map(spec, state)
-
-
-def _rolling_state_specs(state) -> Any:
-    # rolling state: seen [K], stored leaves [K] -> all sharded on axis 0
-    return jax.tree_util.tree_map(
-        lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
-    )
-
-
 class _ShardedMixin:
     """Hook overrides shared by the sharded programs."""
 
@@ -91,16 +72,13 @@ class _ShardedMixin:
     def _local_keys(self, key_col):
         return (key_col.astype(jnp.int32)) // self.n_shards
 
-    def _emission_keys(self):
+    def _global_key_ids(self, local_ids):
         idx = jax.lax.axis_index(AXIS).astype(jnp.int32)
-        return (
-            jnp.arange(self.local_key_capacity, dtype=jnp.int32) * self.n_shards
-            + idx
-        )
+        return local_ids.astype(jnp.int32) * self.n_shards + idx
 
-    def _sharded_jit(self, state_spec_fn):
+    def _sharded_jit(self):
         state = self.init_state()
-        state_specs = state_spec_fn(state)
+        state_specs = self.state_specs(state)
         in_specs = (
             state_specs,
             P(AXIS),  # cols (tuple leaves share the spec via tree prefix)
@@ -125,7 +103,7 @@ class ShardedWindowProgram(_ShardedMixin, WindowProgram):
         self._setup_sharding(cfg)
 
     def jitted_step(self):
-        return self._sharded_jit(_state_specs)
+        return self._sharded_jit()
 
 
 class ShardedSessionWindowProgram(_ShardedMixin, SessionWindowProgram):
@@ -134,7 +112,7 @@ class ShardedSessionWindowProgram(_ShardedMixin, SessionWindowProgram):
         self._setup_sharding(cfg)
 
     def jitted_step(self):
-        return self._sharded_jit(_state_specs)
+        return self._sharded_jit()
 
 
 class ShardedRollingProgram(_ShardedMixin, RollingProgram):
@@ -143,4 +121,4 @@ class ShardedRollingProgram(_ShardedMixin, RollingProgram):
         self._setup_sharding(cfg)
 
     def jitted_step(self):
-        return self._sharded_jit(_rolling_state_specs)
+        return self._sharded_jit()

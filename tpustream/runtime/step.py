@@ -55,6 +55,18 @@ class BaseProgram:
     def jitted_step(self):
         return jax.jit(self._step, donate_argnums=0)
 
+    def state_specs(self, state):
+        """Mesh sharding specs for the state pytree (default: arrays with
+        a leading key axis of ndim >= 2 shard on it, scalars replicate).
+        Programs with other layouts override."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import AXIS
+
+        return jax.tree_util.tree_map(
+            lambda leaf: P(AXIS) if leaf.ndim >= 2 else P(), state
+        )
+
     # -- SPMD hooks: identity on one chip, mesh collectives when sharded --
     n_shards = 1
     vary_axes: tuple = ()
@@ -122,6 +134,16 @@ class RollingProgram(BaseProgram):
             _np_dtype(k) if k != STR else np.int32 for k in self.mid_kinds
         ]
         return rolling_ops.init_rolling_state(self.cfg.key_capacity, dtypes)
+
+    def state_specs(self, state):
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import AXIS
+
+        # rolling state: seen [K], stored leaves [K] -> sharded on axis 0
+        return jax.tree_util.tree_map(
+            lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
+        )
 
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)

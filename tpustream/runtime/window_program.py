@@ -8,18 +8,27 @@ out-of-orderness watermarks with late-drop (chapter3/README.md:195-213),
 allowed lateness with per-arrival re-fire and late-data side output
 (chapter3/README.md:209-228).
 
-Execution model per step (SURVEY.md §7):
+Execution model per step (SURVEY.md §7), tuned from per-op measurements
+on v5e (the scatter/gather cost model in docs/architecture.md):
+
   1. masked pre-chain (map/filter) over the batch,
   2. watermark update: monotone ``max(max_seen - delay, clock_hint)``,
   3. late split against the PRE-batch watermark,
-  4. pane scatter: sort by (key, pane) cell, segmented associative scan
-     with the user combiner, merge segment tails into the [K, N] ring,
-  5. fire: statically-enumerated window-end candidates crossing the
-     watermark; (key, window) occupancy via one MXU matmul; fired rows
-     are compacted FIRST (device-side nonzero to `alert_capacity` rows),
-     then composed pane-by-pane with the user combiner in event-time
-     order, finalized, and run through the post chain — so per-fire cost
-     scales with alerts emitted, not with keys x candidates.
+  4. state merge: sort by (slot, key) cell, segmented associative scan
+     with the user combiner, then ONE int32 set-scatter per storage
+     plane at segment tails. State lives as int32 "word planes"
+     ``[n_slots, keys]`` (ops/wordplanes.py) because v5e emulates 64-bit
+     scatters ~8x slower than 32-bit ones; leaves the post chain can
+     never observe are pruned entirely (ops/liveness.py), and a reduce
+     key column that the combiner passes through verbatim is
+     reconstructed from the cell index instead of stored.
+  5. fire: window ends that crossed the watermark fire IN ORDER, up to
+     ``max_fires_per_step`` per step (the executor drains the rest on
+     flush ticks). Each fire composes its panes DENSELY — a fold of
+     dynamic row slices over the ring, O(panes * keys) sequential HBM
+     reads, no large gathers — then finalizes, runs the post chain over
+     all keys at once, and append-compacts surviving alerts into the
+     fixed ``alert_capacity`` output buffer.
 """
 
 from __future__ import annotations
@@ -33,14 +42,15 @@ import numpy as np
 from ..api.functions import as_callable
 from ..api.timeapi import TimeCharacteristic
 from ..records import BOOL, F64, I64, NUMPY_DTYPES, STR
+from ..ops import liveness
 from ..ops import panes as pane_ops
 from ..ops.panes import W0
 from ..ops.segments import (
-    inverse_permutation,
     segment_tails,
     segmented_scan,
     sort_by_key,
 )
+from ..ops.wordplanes import pack_words, plane_dtypes, unpack_words
 from .device import DeviceChain, unwrap_record, wrap_record
 from .plan import JobPlan
 from .step import BaseProgram
@@ -103,6 +113,7 @@ class WindowProgram(BaseProgram):
             )
             self.out_kinds = self.post_chain.out_kinds
             self.out_tables = self.post_chain.out_tables
+            self._analyze_columns()
 
     def _make_ring(self, spec, cfg):
         return pane_ops.make_ring_spec(
@@ -135,6 +146,7 @@ class WindowProgram(BaseProgram):
                 return tuple(leaves)
 
             self.acc_kinds = list(kinds)
+            self._acc_tables = list(tables)
             self.result_kinds = list(kinds)
             self.result_tables = list(tables)
         elif self.apply_kind == "process":
@@ -188,43 +200,168 @@ class WindowProgram(BaseProgram):
         self.combine = combine
         self.finalize = finalize
 
+    # ------------------------------------------------------------------
+    # column analysis: prune dead accumulator leaves, reconstruct keys
+    # ------------------------------------------------------------------
+    def _analyze_columns(self) -> None:
+        arity = len(self.acc_kinds)
+        dummies = [_dummy_scalar(k) for k in self.acc_kinds]
+
+        def result_probe(*acc_scalars):
+            res = self.finalize(tuple(acc_scalars))
+            outs, keep, _, _ = self.post_chain._record_fn(
+                list(res), jnp.asarray(True)
+            )
+            return tuple(outs) + (keep,)
+
+        def combine_probe(*ab):
+            return self.combine(tuple(ab[:arity]), tuple(ab[arity:]))
+
+        live = liveness.live_accumulator_leaves(
+            result_probe, combine_probe, dummies, arity
+        )
+        self.live_idx = [i for i, l in enumerate(live) if l]
+        # reduce keeps records: the key leaf is reconstructable from the
+        # cell index when the combiner passes it through verbatim
+        self.key_leaf: Optional[int] = None
+        if self.apply_kind == "reduce":
+            passthrough = liveness.passthrough_outputs(
+                combine_probe, dummies + dummies, arity
+            )
+            if self.key_pos in self.live_idx and passthrough[self.key_pos]:
+                self.key_leaf = self.key_pos
+        self.stored_idx = [i for i in self.live_idx if i != self.key_leaf]
+        self.stored_kinds = [self.acc_kinds[i] for i in self.stored_idx]
+        # compact32 (StreamConfig.acc_dtype int32/float32) stores 64-bit
+        # accumulators in one 32-bit plane; combined with algebraically
+        # recognized combiners it unlocks the scatter-reduce fast path
+        self.compact32 = str(self.cfg.acc_dtype) in ("int32", "float32")
+        self.plane_dtypes = plane_dtypes(self.stored_kinds, self.compact32)
+        ops = liveness.leaf_algebraic_ops(combine_probe, dummies, arity)
+        self.stored_ops = [ops[i] for i in self.stored_idx]
+        self.fast_reduce = (
+            self.compact32
+            and all(op in ("add", "min", "max") for op in self.stored_ops)
+            and len(self.plane_dtypes) == len(self.stored_idx)
+        )
+        n, k = self.ring.n_slots, self.local_key_capacity
+        if n * k >= 2**31:
+            raise ValueError(
+                f"pane ring cells ({n} slots x {k} keys) exceed int32 "
+                "addressing; lower key_capacity or window/pane ratio"
+            )
+
+    def _plane_identity(self, dtype: np.dtype, op: Optional[str]):
+        """Identity element the plane is initialized/retargeted to (the
+        scatter-reduce fast path merges straight into it)."""
+        if op == "min":
+            return (
+                np.finfo(dtype).max
+                if np.issubdtype(dtype, np.floating)
+                else np.iinfo(dtype).max
+            )
+        if op == "max":
+            return (
+                np.finfo(dtype).min
+                if np.issubdtype(dtype, np.floating)
+                else np.iinfo(dtype).min
+            )
+        return 0
+
+    def _plane_identities(self) -> List:
+        if self.fast_reduce:
+            return [
+                self._plane_identity(dt, op)
+                for dt, op in zip(self.plane_dtypes, self.stored_ops)
+            ]
+        return [0 for _ in self.plane_dtypes]
+
+    def _combine_live(self, a_live: Tuple, b_live: Tuple) -> Tuple:
+        """User combiner restricted to live leaves (dead inputs zero —
+        sound because liveness closed over the combiner's dependence)."""
+        arity = len(self.acc_kinds)
+        shape = jnp.shape(a_live[0])
+
+        def fill(live_vals):
+            full = [None] * arity
+            for pos, i in enumerate(self.live_idx):
+                full[i] = live_vals[pos]
+            for i in range(arity):
+                if full[i] is None:
+                    full[i] = jnp.zeros(
+                        shape, dtype=self._acc_dtype(self.acc_kinds[i])
+                    )
+            return tuple(full)
+
+        out = self.combine(fill(a_live), fill(b_live))
+        return tuple(out[i] for i in self.live_idx)
+
     def _acc_dtype(self, kind: str):
         return np.int32 if kind == STR else NUMPY_DTYPES[kind]
 
     # -- SPMD hooks (shared ones live on BaseProgram) -------------------
+    def _global_key_ids(self, local_ids):
+        """Local state row -> global key id (identity on one chip; the
+        sharded mixin interleaves by shard). Both the combiner's
+        reconstructed key leaf and emissions must use GLOBAL ids so the
+        sharded program matches the single-chip one."""
+        return local_ids.astype(jnp.int32)
+
     def _emission_keys(self):
-        return jnp.arange(self.local_key_capacity, dtype=jnp.int32)
+        return self._global_key_ids(
+            jnp.arange(self.local_key_capacity, dtype=jnp.int32)
+        )
+
+    def state_specs(self, state):
+        """Sharding specs: planes/cnt are FLAT shard-major cell arrays
+        (``[shard][slot][local_key]``) — splitting axis 0 contiguously
+        hands each shard exactly its local ``[slots * local_keys]`` flat
+        plane. Ring metadata and scalars replicate."""
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import AXIS
+
+        specs = {
+            k: jax.tree_util.tree_map(lambda _: P(), v)
+            for k, v in state.items()
+        }
+        specs["planes"] = [P(AXIS) for _ in state["planes"]]
+        specs["cnt"] = P(AXIS)
+        return specs
 
     # ------------------------------------------------------------------
     def init_state(self):
-        k, n = self.cfg.key_capacity, self.ring.n_slots
+        # planes live FLAT (cell = slot * keys + key): reshape wrappers
+        # around the per-batch scatter defeat XLA's in-place aliasing and
+        # re-copy the GB-scale state every step (4x step cost, measured);
+        # flat layout also shards as contiguous per-device chunks
+        n, kk = self.ring.n_slots, self.cfg.key_capacity
         hi0 = jnp.asarray(-1, dtype=jnp.int64)
+        idents = self._plane_identities()
         return {
-            "acc": [
-                jnp.zeros((k, n), dtype=self._acc_dtype(kd))
-                for kd in self.acc_kinds
+            "planes": [
+                jnp.full((n * kk,), ident, dtype=dt)
+                for dt, ident in zip(self.plane_dtypes, idents)
             ],
-            "cnt": jnp.zeros((k, n), dtype=jnp.int32),
+            "cnt": jnp.zeros((n * kk,), dtype=jnp.int32),
             "slot_pane": pane_ops.slot_targets(hi0, self.ring),
             "hi": hi0,
             "wm": jnp.asarray(W0, dtype=jnp.int64),
             "max_ts": jnp.asarray(W0, dtype=jnp.int64),
+            "fired_through": jnp.asarray(W0, dtype=jnp.int64),
+            "pending_fires": jnp.zeros((), dtype=jnp.int64),
             "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
             "alert_overflow": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
         }
 
     # ------------------------------------------------------------------
+    # legacy typed-cell scatter — kept for SessionWindowProgram, which
+    # stores typed [keys, slots] accumulators plus per-cell timestamps
+    # ------------------------------------------------------------------
     def _scatter_cells(self, leaves, cnt, keys, batch_leaves, live, pane, combine):
-        """Merge a batch into the (key, pane) ring via sort + segmented
-        scan with ``combine`` (arrival order preserved).
-
-        ``leaves``: list of [K, N] state arrays; ``batch_leaves``: matching
-        [B] lifted batch values. Every state write happens at SEGMENT
-        TAILS — one unique index per touched cell — so XLA lowers to
-        vectorized scatters instead of the serialized non-unique path
-        (the TPU scatter trap). Returns (new_leaves, new_cnt, sc, tails).
-        """
+        """Merge a batch into [K, N]-typed cell state via sort + segmented
+        scan with ``combine`` (arrival order preserved); every state write
+        happens at SEGMENT TAILS (unique indices)."""
         k, n = self.local_key_capacity, self.ring.n_slots
         slot = jnp.mod(pane, n)
         cell = keys.astype(jnp.int64) * n + slot
@@ -263,140 +400,265 @@ class WindowProgram(BaseProgram):
         )
         return new_leaves, new_cnt, sc, tails
 
-    def _scatter_batch(self, state, keys, mid_cols, live, pane):
+    # ------------------------------------------------------------------
+    # word-plane state merge (the hot path)
+    # ------------------------------------------------------------------
+    def _scatter_words(self, planes, cnt, keys, mid_cols, live, pane):
+        """Merge a batch into the flat cell planes.
+
+        Fast path (commutative combiner + 32-bit planes): one non-unique
+        scatter-add/min/max per plane straight into the identity-
+        initialized state — no sort, no segmented scan, no gathers.
+        Generic path: sort by (slot, key), combine same-cell records
+        with a segmented scan over LIVE leaves, then set-scatter merged
+        storage words at segment tails (one 32-bit scatter per plane)."""
         k, n = self.local_key_capacity, self.ring.n_slots
-        new_acc, new_cnt, sc, tails = self._scatter_cells(
-            state["acc"], state["cnt"], keys,
-            self.lift(list(mid_cols)), live, pane, self.combine,
+        slot = jnp.mod(pane, n).astype(jnp.int32)
+        cell = slot * k + keys  # slot-major == plane memory order
+
+        if self.fast_reduce:
+            idx = jnp.where(live, cell, n * k)
+            lifted = self.lift(list(mid_cols))
+            new_planes = []
+            for p, i, op in zip(planes, self.stored_idx, self.stored_ops):
+                (val,) = pack_words(
+                    [lifted[i]], [self.acc_kinds[i]], self.compact32
+                )
+                new_planes.append(
+                    getattr(p.at[idx], op)(val.astype(p.dtype), mode="drop")
+                )
+            new_cnt = cnt.at[idx].add(1, mode="drop")
+            if self.allowed_lateness_ms > 0:
+                touched_slot = (
+                    jnp.zeros((n + 1,), dtype=jnp.int32)
+                    .at[jnp.where(live, slot, n)]
+                    .max(1, mode="drop")
+                )[:n] > 0
+            else:
+                touched_slot = pane_ops.vary(
+                    jnp.zeros((n,), dtype=bool), self.vary_axes
+                )
+            return new_planes, new_cnt, touched_slot
+
+        perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=n * k)
+        sc = sc.astype(jnp.int32)
+
+        lifted = self.lift(list(mid_cols))
+        live_sorted = tuple(lifted[i][perm] for i in self.live_idx)
+        prefix = segmented_scan(live_sorted, seg_starts, self._combine_live)
+        tails = segment_tails(seg_starts) & sv
+
+        b = sv.shape[0]
+        pos = jnp.arange(b, dtype=jnp.int64)
+        seg_first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(seg_starts, pos, 0)
+        )
+        seg_count = (pos - seg_first + 1).astype(jnp.int32)
+
+        sc_c = jnp.clip(sc, 0, n * k - 1)
+        old_words = [p[sc_c] for p in planes]
+        old_cnt = cnt[sc_c]
+        old_stored = unpack_words(old_words, self.stored_kinds, self.compact32)
+        # live tuple for the OLD cell value: stored leaves from planes,
+        # the key leaf reconstructed from the cell index
+        old_live = self._live_from_stored(
+            old_stored, self._global_key_ids(jnp.mod(sc_c, k))
+        )
+        merged = self._combine_live(tuple(old_live), prefix)
+        has_old = (old_cnt > 0) & sv
+        new_live = [
+            jnp.where(has_old, m, p) for m, p in zip(merged, prefix)
+        ]
+        new_stored = [
+            new_live[self.live_idx.index(i)] for i in self.stored_idx
+        ]
+        new_words = pack_words(new_stored, self.stored_kinds, self.compact32)
+
+        flat_idx = jnp.where(tails, sc, n * k)
+        new_planes = [
+            p.at[flat_idx].set(
+                w.astype(p.dtype), mode="drop", unique_indices=True
+            )
+            for p, w in zip(planes, new_words)
+        ]
+        new_cnt = cnt.at[flat_idx].set(
+            old_cnt + jnp.where(tails, seg_count, 0),
+            mode="drop",
+            unique_indices=True,
         )
         if self.allowed_lateness_ms > 0:
-            # refire dirtiness needs exact touched-slot tracking
             touched_slot = (
                 jnp.zeros((n + 1,), dtype=jnp.int32)
-                .at[jnp.where(tails, jnp.mod(sc, n), n)]
+                .at[jnp.where(tails, sc // k, n)]
                 .max(1, mode="drop")
             )[:n] > 0
         else:
             touched_slot = pane_ops.vary(
                 jnp.zeros((n,), dtype=bool), self.vary_axes
             )
-        return new_acc, new_cnt, touched_slot
+        return new_planes, new_cnt, touched_slot
+
+    def _live_from_stored(self, stored_vals: List, key_ids) -> List:
+        """Assemble the live-leaf tuple from stored values + key ids."""
+        out = []
+        si = 0
+        for i in self.live_idx:
+            if i == self.key_leaf:
+                kind = self.acc_kinds[i]
+                if kind == STR:
+                    out.append(key_ids.astype(jnp.int32))
+                else:
+                    out.append(key_ids.astype(NUMPY_DTYPES[kind]))
+            else:
+                out.append(stored_vals[si])
+                si += 1
+        return out
 
     # ------------------------------------------------------------------
-    def _fire(self, state, acc, cnt, slot_pane, hi, wm_old, wm_new, touched_slot):
+    # dense fire path
+    # ------------------------------------------------------------------
+    def _fire_dense(
+        self, planes, cnt, slot_pane, hi, wm_old, wm_new, fired_through, touched
+    ):
         ring = self.ring
         k, n, f = self.local_key_capacity, ring.n_slots, ring.n_fire_candidates
-        cand, ends, fire = pane_ops.fire_candidates(hi, wm_old, wm_new, ring)
+        cap = self.cfg.alert_capacity
+        j = jnp.arange(f, dtype=jnp.int64)
+        cand = hi - n + 1 + j
+        ends = (cand + 1) * ring.pane_ms
+        aligned = jnp.mod(ends, ring.slide_ms) == 0
+        pending = aligned & (ends - 1 <= wm_new) & (ends - 1 > fired_through)
+        budget = self.cfg.max_fires_per_step or f
+        csum = jnp.cumsum(pending.astype(jnp.int32))
+        fire_now = pending & (csum <= budget)
+        n_deferred = (jnp.sum(pending) - jnp.sum(fire_now)).astype(jnp.int64)
         if self.allowed_lateness_ms > 0:
-            # allowed-late arrivals re-fire already-fired windows they touch
-            # (chapter3/README.md:212 option 2)
+            # allowed-late arrivals re-fire already-fired windows they
+            # touch (chapter3/README.md:212 option 2). Refires are EXEMPT
+            # from the fire budget: the dirty/touched flag is per-step and
+            # not persisted, so a deferred refire would be lost — and the
+            # dirty set is per-shard anyway, while the budgeted pending
+            # bookkeeping must stay replicated across shards.
             member = (slot_pane[:, None] <= cand[None, :]) & (
                 slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
             )
-            dirty = (touched_slot.astype(jnp.int32) @ member.astype(jnp.int32)) > 0
-            aligned = jnp.mod(ends, ring.slide_ms) == 0
+            dirty = (touched.astype(jnp.int32) @ member.astype(jnp.int32)) > 0
             refire = (
                 aligned
-                & (ends - 1 <= wm_old)
+                & (ends - 1 <= fired_through)
                 & (ends - 1 + self.allowed_lateness_ms > wm_old)
                 & dirty
             )
-            fire = fire | refire
-        any_fire = jnp.any(fire)
-
-        cap = self.cfg.alert_capacity
-        # exact (every fired (key, window) row composed) whenever K*F is
-        # small; bounded at >=1M rows for huge-key jobs, where steady-state
-        # fires (active keys x 1 slide) still fit and only bounded-stream
-        # EOS mass-fires can overflow (counted in alert_overflow)
-        fcap = self.cfg.fire_capacity or min(
-            self.local_key_capacity * f, max(cap, 1 << 20)
+            fire_now = fire_now | refire
+        new_ft = jnp.maximum(
+            fired_through,
+            jnp.max(jnp.where(fire_now & pending, ends - 1, W0)),
         )
+        any_fire = jnp.any(fire_now)
+
+        out_dtypes = [
+            self._acc_dtype(kd) for kd in self.post_chain.out_kinds
+        ] + [np.int32, np.int64]  # + key, window_end
+        v = lambda x: pane_ops.vary(x, self.vary_axes)
+        zero_out = [v(jnp.zeros((cap,), dtype=dt)) for dt in out_dtypes]
+        zero_cnt = v(jnp.zeros((), dtype=jnp.int32))
+        zero_ovf = v(jnp.zeros((), dtype=jnp.int64))
+        key_col = self._emission_keys()
 
         def do_fire(_):
-            # 1. occupancy of every (key, window) pair via one MXU matmul:
-            #    member[s, j] = slot s's pane belongs to candidate j
-            member = (slot_pane[:, None] <= cand[None, :]) & (
-                slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
-            )                                              # [N, F]
-            occ = (cnt > 0).astype(jnp.float32) @ member.astype(jnp.float32)
-            emit_mask = fire[None, :] & (occ > 0.5)        # [K, F]
+            def cand_body(carry, jj):
+                out_cols, count, ovf = carry
 
-            # 2. compact occupied fired windows — (window end, key) order
-            #    via F-major flatten — to `fire_capacity` rows, so the
-            #    combine fold, finalize, and the (possibly f64) post chain
-            #    run on <= fcap rows, not K*F
-            flatT = lambda x: x.T.reshape(-1)
-            idx, fvalid, fire_ovf, _ = pane_ops.compact(
-                flatT(emit_mask), [], fcap
-            )
-            f_idx = (idx // k).astype(jnp.int32)
-            k_idx = jnp.mod(idx, k).astype(jnp.int32)
-            cand_sel = cand[f_idx]                         # [fcap]
+                def fire_one(c2):
+                    out_cols, count, ovf = c2
+                    e_pane = cand[jj]
 
-            # 3. compose each selected window's panes in event-time order:
-            #    P gathers of [fcap] cells (earliest pane first, so
-            #    non-commutative reduce sees arrival-time order)
-            def body(carry, o):
-                has, outs = carry
-                pane_sel = cand_sel - (ring.panes_per_window - 1) + o
-                slot_sel = jnp.mod(pane_sel, n).astype(jnp.int32)
-                present = (
-                    (slot_pane[slot_sel] == pane_sel)
-                    & (pane_sel >= 0)
-                    & (cnt[k_idx, slot_sel] > 0)
-                    & fvalid
-                )
-                cells = [a[k_idx, slot_sel] for a in acc]
-                merged = self.combine(tuple(outs), tuple(cells))
-                new_outs = [
-                    jnp.where(
-                        present & has, m, jnp.where(present, c, o_)
+                    def pane_body(c3, o):
+                        has, acc_live = c3
+                        pane_sel = e_pane - (ring.panes_per_window - 1) + o
+                        slot_sel = jnp.mod(pane_sel, n).astype(jnp.int32)
+                        row0 = slot_sel * k
+                        rows = [
+                            jax.lax.dynamic_slice(p, (row0,), (k,))
+                            for p in planes
+                        ]
+                        cnt_row = jax.lax.dynamic_slice(cnt, (row0,), (k,))
+                        ok = (slot_pane[slot_sel] == pane_sel) & (pane_sel >= 0)
+                        present = ok & (cnt_row > 0)
+                        stored = unpack_words(
+                            rows, self.stored_kinds, self.compact32
+                        )
+                        cell_live = self._live_from_stored(stored, key_col)
+                        merged = self._combine_live(
+                            tuple(acc_live), tuple(cell_live)
+                        )
+                        new_acc = [
+                            jnp.where(
+                                present & has, m, jnp.where(present, c, a)
+                            )
+                            for m, c, a in zip(merged, cell_live, acc_live)
+                        ]
+                        return (has | present, new_acc), None
+
+                    has0 = v(jnp.zeros((k,), dtype=bool))
+                    acc0 = [
+                        v(
+                            jnp.zeros(
+                                (k,), dtype=self._acc_dtype(self.acc_kinds[i])
+                            )
+                        )
+                        for i in self.live_idx
+                    ]
+                    (has, acc_live), _ = jax.lax.scan(
+                        pane_body,
+                        (has0, acc0),
+                        jnp.arange(ring.panes_per_window, dtype=jnp.int64),
                     )
-                    for m, c, o_ in zip(merged, cells, outs)
-                ]
-                return (has | present, new_outs), None
 
-            v = lambda x: pane_ops.vary(x, self.vary_axes)
-            has0 = v(jnp.zeros((fcap,), dtype=bool))
-            outs0 = [v(jnp.zeros((fcap,), dtype=a.dtype)) for a in acc]
-            (_, outs), _ = jax.lax.scan(
-                body, (has0, outs0),
-                jnp.arange(ring.panes_per_window, dtype=jnp.int64),
+                    # full accumulator (dead leaves zero), finalize + post
+                    full = [None] * len(self.acc_kinds)
+                    for posi, i in enumerate(self.live_idx):
+                        full[i] = acc_live[posi]
+                    for i, kd in enumerate(self.acc_kinds):
+                        if full[i] is None:
+                            full[i] = v(
+                                jnp.zeros((k,), dtype=self._acc_dtype(kd))
+                            )
+                    results = jax.vmap(
+                        lambda *leaves: tuple(self.finalize(tuple(leaves)))
+                    )(*full)
+                    post_cols, post_mask = self.post_chain.apply(
+                        list(results), has
+                    )
+                    emit = post_mask & has
+
+                    # append-compact the fired alerts after current count
+                    end_col = jnp.zeros((k,), dtype=jnp.int64) + ends[jj]
+                    src_cols = post_cols + [key_col, end_col]
+                    out_cols, new_count, overflowed = pane_ops.append_compact(
+                        emit, src_cols, out_cols, count, cap
+                    )
+                    return out_cols, new_count, ovf + overflowed
+
+                return jax.lax.cond(
+                    fire_now[jj], fire_one, lambda c2: c2, (out_cols, count, ovf)
+                ), None
+
+            (out_cols, count, ovf), _ = jax.lax.scan(
+                cand_body,
+                (list(zero_out), zero_cnt, zero_ovf),
+                jnp.arange(f),
             )
-
-            results = self.finalize(tuple(outs))           # leaves [fcap]
-            post_cols, post_mask = self.post_chain.apply(list(results), fvalid)
-            key_col = self._emission_keys()[k_idx]
-            end_col = ends[f_idx]
-
-            # 4. compact again on the post-filter mask so `alert_capacity`
-            #    bounds ALERTS, not fired windows (a selective filter must
-            #    not have its survivors starved by non-alerting rows)
-            _, valid, alert_ovf, out = pane_ops.compact(
-                post_mask & fvalid,
-                post_cols + [key_col, end_col],
-                cap,
-            )
-            return valid, out, fire_ovf + alert_ovf
+            return out_cols, count, ovf
 
         def no_fire(_):
-            v = lambda x: pane_ops.vary(x, self.vary_axes)
-            zero_cols = [
-                v(jnp.zeros((cap,), dtype=self._acc_dtype(kd)))
-                for kd in self.post_chain.out_kinds
-            ]
-            return (
-                v(jnp.zeros((cap,), dtype=bool)),
-                zero_cols
-                + [
-                    v(jnp.zeros((cap,), dtype=jnp.int32)),
-                    v(jnp.zeros((cap,), dtype=jnp.int64)),
-                ],
-                v(jnp.zeros((), dtype=jnp.int64)),
-            )
+            return list(zero_out), zero_cnt, zero_ovf
 
-        return jax.lax.cond(any_fire, do_fire, no_fire, operand=None)
+        out_cols, count, overflow = jax.lax.cond(
+            any_fire, do_fire, no_fire, operand=None
+        )
+        emit_valid = jnp.arange(cap, dtype=jnp.int32) < count
+        return emit_valid, out_cols, overflow, new_ft, n_deferred
 
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
@@ -421,44 +683,64 @@ class WindowProgram(BaseProgram):
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
-        # ring retarget rewrites the whole [K, N] state, so gate it on an
-        # actual pane-boundary advance (most steps stay inside one pane)
-        init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
+        # ring retarget rewrites the whole [N, K] state, so gate it on an
+        # actual pane-boundary advance (most steps stay inside one pane);
+        # the reshape round-trip copies the planes but only on this rare
+        # path — the per-batch scatter stays reshape-free
+        init_leaves = [
+            jnp.asarray(ident, dtype=p.dtype)
+            for p, ident in zip(state["planes"], self._plane_identities())
+        ]
+        n_slots, kloc = ring.n_slots, self.local_key_capacity
 
         def do_retarget(_):
-            return pane_ops.retarget(
-                state["acc"], state["cnt"], state["slot_pane"], hi, wm_old,
-                ring, init_leaves,
+            planes2d, cnt2d, slot_pane2, evicted = pane_ops.retarget_rows(
+                [p.reshape(n_slots, kloc) for p in state["planes"]],
+                state["cnt"].reshape(n_slots, kloc),
+                state["slot_pane"], hi,
+                state["fired_through"], ring, init_leaves,
+            )
+            return (
+                [p.reshape(-1) for p in planes2d],
+                cnt2d.reshape(-1),
+                slot_pane2,
+                evicted,
             )
 
         def skip_retarget(_):
             return (
-                list(state["acc"]),
+                list(state["planes"]),
                 state["cnt"],
                 state["slot_pane"],
                 pane_ops.vary(jnp.zeros((), dtype=jnp.int64), self.vary_axes),
             )
 
-        acc, cnt, slot_pane, evicted = jax.lax.cond(
+        planes, cnt, slot_pane, evicted = jax.lax.cond(
             hi > state["hi"], do_retarget, skip_retarget, operand=None
         )
-        acc, cnt, touched = self._scatter_batch(
-            {"acc": acc, "cnt": cnt}, keys, mid_cols, live, pane
+        planes, cnt, touched = self._scatter_words(
+            planes, cnt, keys, mid_cols, live, pane
         )
 
-        emit_valid, emit_cols, overflow = self._fire(
-            state, acc, cnt, slot_pane, hi, wm_old, wm_new, touched
+        emit_valid, emit_cols, overflow, new_ft, n_pending = self._fire_dense(
+            planes, cnt, slot_pane, hi, wm_old, wm_new,
+            state["fired_through"], touched,
         )
 
         n_shards = max(1, self.cfg.parallelism)
         key_out = emit_cols[-2]
         new_state = {
-            "acc": acc,
+            "planes": planes,
             "cnt": cnt,
             "slot_pane": slot_pane,
             "hi": hi,
             "wm": wm_new,
             "max_ts": new_max,
+            "fired_through": new_ft,
+            # pending is computed from replicated scalars (hi/wm/ft), so
+            # every shard holds the same value — pmax replicates it
+            # without the n_shards inflation a psum would introduce
+            "pending_fires": self._global_max(n_pending),
             "evicted_unfired": state["evicted_unfired"]
             + self._global_sum(evicted),
             "alert_overflow": state["alert_overflow"]
