@@ -34,11 +34,13 @@ class StreamConfig:
 
     # -- emission / alerts --------------------------------------------------
     alert_capacity: int = 65536       # compacted device->host alert slots/step
-    fire_capacity: Optional[int] = None  # fired (key, window) rows composed
-                                         # per step before the post-chain
-                                         # filter; None = key_capacity (one
-                                         # full slide wave). Overflow beyond
-                                         # either capacity is counted in
+    fire_capacity: Optional[int] = None  # SESSION windows only: fired
+                                         # (key, session) rows composed per
+                                         # step before the post-chain filter;
+                                         # None = key_capacity. Time windows
+                                         # compose fires densely and don't
+                                         # use this. Overflow beyond either
+                                         # capacity is counted in
                                          # state["alert_overflow"].
 
     # -- numerics -----------------------------------------------------------
