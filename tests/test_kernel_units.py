@@ -187,13 +187,13 @@ def test_rolling_matches_reference_across_batches(kind):
     rng = np.random.default_rng(42)
     kcap, b, nb = 17, 128, 3
     combine = make_combiner(kind, 1)
-    state = init_rolling_state(kcap, [jnp.int32, jnp.float32])
+    state = init_rolling_state(kcap, ["str", "f64"])
 
     batches = []
     for _ in range(nb):
         keys = rng.integers(0, kcap, b).astype(np.int32)
         c0 = rng.integers(0, 100, b).astype(np.int32)
-        c1 = np.round(rng.random(b) * 100, 1).astype(np.float32)
+        c1 = np.round(rng.random(b) * 100, 1).astype(np.float64)
         valid = rng.random(b) < 0.85
         batches.append((keys, (c0, c1), valid))
 
@@ -205,6 +205,7 @@ def test_rolling_matches_reference_across_batches(kind):
             tuple(jnp.asarray(c) for c in cols),
             jnp.asarray(valid),
             combine,
+            ["str", "f64"],
         )
         for c in range(2):
             np.testing.assert_allclose(

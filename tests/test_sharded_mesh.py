@@ -26,13 +26,14 @@ def et_lines(n_keys=16, n_records=200):
     return lines
 
 
-def run_et(lines, parallelism, batch_size=40, key_capacity=64):
+def run_et(lines, parallelism, batch_size=40, key_capacity=64, **cfg_overrides):
     env = StreamExecutionEnvironment(
         StreamConfig(
             parallelism=parallelism,
             batch_size=batch_size,
             key_capacity=key_capacity,
             print_parallelism=1,
+            **cfg_overrides,
         )
     )
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
@@ -134,3 +135,18 @@ def test_exchange_roundtrip_all_records():
         sl = slice(d * rows_per_shard, (d + 1) * rows_per_shard)
         owned = k2[sl][ok[sl]]
         assert all(int(k) % s == d for k in owned)
+
+
+def test_sharded_fast_reduce_path_matches_single_chip_exact():
+    """The 32-bit scatter-reduce fast path with a per-step fire budget,
+    sharded over 8 devices, must equal the exact single-chip results."""
+    lines = et_lines()
+    exact_single = run_et(lines, parallelism=1)
+    fast_sharded = run_et(
+        lines,
+        parallelism=8,
+        acc_dtype="int32",        # scatter-reduce fast path
+        max_fires_per_step=2,     # exercise deferred fires sharded
+    )
+    assert len(exact_single) > 0
+    assert exact_single == fast_sharded

@@ -18,7 +18,7 @@ emulated scatter is irrelevant.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Sequence, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -26,43 +26,55 @@ import numpy as np
 from ..records import BOOL, F64, I64, STR
 
 
-def plane_dtypes(kinds: Sequence[str], compact32: bool = False) -> List[np.dtype]:
+def _per_leaf(compact32, kinds) -> List[bool]:
+    if isinstance(compact32, (list, tuple)):
+        return list(compact32)
+    return [bool(compact32)] * len(kinds)
+
+
+def plane_dtypes(
+    kinds: Sequence[str], compact32: Union[bool, Sequence[bool]] = False
+) -> List[np.dtype]:
     """Storage plane dtypes for a leaf-kind list (i64 -> two int32).
 
     ``compact32`` is the opt-in lossy accumulator mode
     (``StreamConfig.acc_dtype`` int32/float32): 64-bit leaves keep ONE
     32-bit plane (int64 wraps mod 2^32, float64 rounds to f32) so
     commutative combiners can use the non-unique scatter-reduce fast
-    path directly on the plane."""
+    path directly on the plane. A per-leaf sequence restricts the mode
+    to the leaves a combiner actually aggregates (pass-through record
+    fields keep exact storage)."""
     out: List[np.dtype] = []
-    for k in kinds:
+    for k, c32 in zip(kinds, _per_leaf(compact32, kinds)):
         if k == I64:
-            if compact32:
+            if c32:
                 out.append(np.dtype(np.int32))
             else:
                 out.extend([np.dtype(np.int32), np.dtype(np.int32)])
         elif k == F64:
-            out.append(np.dtype(np.float32) if compact32 else np.dtype(np.float64))
+            out.append(np.dtype(np.float32) if c32 else np.dtype(np.float64))
         else:  # STR (interned id), BOOL
             out.append(np.dtype(np.int32))
     return out
 
 
 def pack_words(
-    cols: Sequence[jnp.ndarray], kinds: Sequence[str], compact32: bool = False
+    cols: Sequence[jnp.ndarray],
+    kinds: Sequence[str],
+    compact32: Union[bool, Sequence[bool]] = False,
 ) -> List[jnp.ndarray]:
     """Typed arrays -> storage plane arrays (i64 split as lo, hi)."""
     words: List[jnp.ndarray] = []
-    for col, kind in zip(cols, kinds):
+    for col, kind, c32 in zip(cols, kinds, _per_leaf(compact32, kinds)):
         if kind == I64:
-            if compact32:
+            if c32:
                 words.append(col.astype(jnp.int32))
             else:
                 v = col.astype(jnp.int64)
                 words.append((v & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32))
                 words.append((v >> 32).astype(jnp.int32))
         elif kind == F64:
-            words.append(col.astype(jnp.float32 if compact32 else jnp.float64))
+            words.append(col.astype(jnp.float32 if c32 else jnp.float64))
         elif kind == BOOL:
             words.append(col.astype(jnp.int32))
         else:
@@ -71,14 +83,16 @@ def pack_words(
 
 
 def unpack_words(
-    words: Sequence[jnp.ndarray], kinds: Sequence[str], compact32: bool = False
+    words: Sequence[jnp.ndarray],
+    kinds: Sequence[str],
+    compact32: Union[bool, Sequence[bool]] = False,
 ) -> List[jnp.ndarray]:
     """Inverse of :func:`pack_words`."""
     cols: List[jnp.ndarray] = []
     w = 0
-    for kind in kinds:
+    for kind, c32 in zip(kinds, _per_leaf(compact32, kinds)):
         if kind == I64:
-            if compact32:
+            if c32:
                 cols.append(words[w].astype(jnp.int64))
                 w += 1
             else:

@@ -237,6 +237,11 @@ class Runner:
             self.metrics.step_times_s.append(sw.elapsed)
             self.metrics.records_in += int(sub.n)
             self._dispatch(emissions)
+            # with a max_fires_per_step budget, drain deferred window ends
+            # BEFORE the next batch can advance the pane ring past them —
+            # each drain step still fires at most `budget` ends, so the
+            # per-step latency bound holds while no fire is ever lost
+            self._drain(wm_lower)
 
     def flush(self, wm_lower: int):
         """Advance time with an empty batch (processing-time tick / EOS).
@@ -264,8 +269,35 @@ class Runner:
             ts = jnp.zeros((cfg.batch_size,), dtype=jnp.int64)
             self._empty_cache = (cols, valid, ts)
         cols, valid, ts = self._empty_cache
-        max_rounds = getattr(self.program, "ring", None)
-        max_rounds = (max_rounds.n_fire_candidates + 1) if max_rounds else 1
+        with Stopwatch() as sw:
+            self.state, emissions = self.step(
+                self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
+            )
+            emissions = jax.device_get(emissions)
+        self.metrics.step_times_s.append(sw.elapsed)
+        self._dispatch(emissions)
+        self._drain(wm_lower)
+
+    def _drain(self, wm_lower: int):
+        """Run empty-batch steps until no window fires remain deferred by
+        the max_fires_per_step budget (no-op for programs without one).
+
+        Without a budget every step fires all due ends, so pending is
+        provably zero — skip even the scalar device_get on the hot loop."""
+        if self.cfg.max_fires_per_step is None:
+            return
+        pending = (
+            self.state.get("pending_fires")
+            if isinstance(self.state, dict)
+            else None
+        )
+        if pending is None or int(jax.device_get(pending)) == 0:
+            return
+        if self._empty_cache is None:
+            self.flush(wm_lower)  # builds the cache and runs one round
+            return
+        cols, valid, ts = self._empty_cache
+        max_rounds = self.program.ring.n_fire_candidates + 1
         for _ in range(max_rounds):
             with Stopwatch() as sw:
                 self.state, emissions = self.step(
@@ -274,8 +306,7 @@ class Runner:
                 emissions = jax.device_get(emissions)
             self.metrics.step_times_s.append(sw.elapsed)
             self._dispatch(emissions)
-            pending = self.state.get("pending_fires") if isinstance(self.state, dict) else None
-            if pending is None or int(jax.device_get(pending)) == 0:
+            if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 
     def _dispatch(self, emissions):

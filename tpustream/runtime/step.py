@@ -129,18 +129,31 @@ class RollingProgram(BaseProgram):
         self.out_kinds = self.post_chain.out_kinds
         self.out_tables = self.post_chain.out_tables
 
+    @property
+    def _compact32(self):
+        """Per-leaf 32-bit accumulator flags: the lossy opt-in
+        (acc_dtype int32/float32) applies ONLY to the field the rolling
+        aggregate actually combines numerically — pass-through record
+        fields (Flink's kept first-record values, chapter2/README.md:
+        60-66) and whole-record max_by/min_by winners stay exact."""
+        if str(self.cfg.acc_dtype) not in ("int32", "float32"):
+            return False
+        st = self.plan.stateful
+        if st.kind == "rolling" and st.rolling_kind in ("max", "min", "sum"):
+            return [i == st.rolling_pos for i in range(len(self.mid_kinds))]
+        return False
+
     def init_state(self):
-        dtypes = [
-            _np_dtype(k) if k != STR else np.int32 for k in self.mid_kinds
-        ]
-        return rolling_ops.init_rolling_state(self.cfg.key_capacity, dtypes)
+        return rolling_ops.init_rolling_state(
+            self.cfg.key_capacity, self.mid_kinds, self._compact32
+        )
 
     def state_specs(self, state):
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import AXIS
 
-        # rolling state: seen [K], stored leaves [K] -> sharded on axis 0
+        # rolling state: seen [K], storage planes [K] -> sharded on axis 0
         return jax.tree_util.tree_map(
             lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
         )
@@ -151,7 +164,8 @@ class RollingProgram(BaseProgram):
         gkeys = mid_cols[self.key_pos]
         keys = self._local_keys(gkeys)
         new_state, emitted = rolling_ops.rolling_step(
-            state, keys, tuple(mid_cols), mask, self.combine
+            state, keys, tuple(mid_cols), mask, self.combine,
+            self.mid_kinds, self._compact32,
         )
         out_cols, out_mask = self.post_chain.apply(list(emitted), mask)
         n_shards = max(1, self.cfg.parallelism)

@@ -232,15 +232,21 @@ class WindowProgram(BaseProgram):
                 self.key_leaf = self.key_pos
         self.stored_idx = [i for i in self.live_idx if i != self.key_leaf]
         self.stored_kinds = [self.acc_kinds[i] for i in self.stored_idx]
-        # compact32 (StreamConfig.acc_dtype int32/float32) stores 64-bit
-        # accumulators in one 32-bit plane; combined with algebraically
-        # recognized combiners it unlocks the scatter-reduce fast path
-        self.compact32 = str(self.cfg.acc_dtype) in ("int32", "float32")
-        self.plane_dtypes = plane_dtypes(self.stored_kinds, self.compact32)
         ops = liveness.leaf_algebraic_ops(combine_probe, dummies, arity)
         self.stored_ops = [ops[i] for i in self.stored_idx]
+        # compact32 (StreamConfig.acc_dtype int32/float32) stores 64-bit
+        # accumulators in one 32-bit plane — but ONLY for leaves the
+        # combiner numerically aggregates; pass-through fields (e.g. a
+        # kept first-record value) stay exact, the opt-in covers
+        # accumulator precision, not record contents. All-algebraic
+        # compact storage unlocks the scatter-reduce fast path.
+        wants32 = str(self.cfg.acc_dtype) in ("int32", "float32")
+        self.compact32 = [
+            wants32 and op in ("add", "min", "max") for op in self.stored_ops
+        ]
+        self.plane_dtypes = plane_dtypes(self.stored_kinds, self.compact32)
         self.fast_reduce = (
-            self.compact32
+            wants32
             and all(op in ("add", "min", "max") for op in self.stored_ops)
             and len(self.plane_dtypes) == len(self.stored_idx)
         )
@@ -420,9 +426,11 @@ class WindowProgram(BaseProgram):
             idx = jnp.where(live, cell, n * k)
             lifted = self.lift(list(mid_cols))
             new_planes = []
-            for p, i, op in zip(planes, self.stored_idx, self.stored_ops):
+            for s, (p, i, op) in enumerate(
+                zip(planes, self.stored_idx, self.stored_ops)
+            ):
                 (val,) = pack_words(
-                    [lifted[i]], [self.acc_kinds[i]], self.compact32
+                    [lifted[i]], [self.acc_kinds[i]], [self.compact32[s]]
                 )
                 new_planes.append(
                     getattr(p.at[idx], op)(val.astype(p.dtype), mode="drop")
