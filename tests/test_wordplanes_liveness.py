@@ -201,3 +201,36 @@ def test_deferred_fires_drain_in_order():
             ends.append(int(np.asarray(em["main"]["window_end"])[0]))
     assert int(np.asarray(state["pending_fires"])) == 0
     assert ends == sorted(ends) and len(ends) >= 2
+
+
+def test_rolling_compact32_keeps_passthrough_fields_exact():
+    """acc_dtype=int32 on a rolling max must truncate NOTHING but the
+    aggregated column — kept first-record fields (which can be 64-bit
+    timestamps) stay exact."""
+    from tpustream.ops.rolling import (
+        init_rolling_state,
+        make_combiner,
+        rolling_step,
+    )
+
+    kinds = ["i64", "str", "f64"]   # big ts, key id, aggregated usage
+    combine = make_combiner("max", 2)
+    compact = [False, False, True]  # what RollingProgram._compact32 yields
+    state = init_rolling_state(16, kinds, compact)
+    big_ts = 1_566_208_860_123_456  # > 2^32: wraps if wrongly compacted
+    keys = jnp.asarray([3, 3], jnp.int32)
+    cols = (
+        jnp.asarray([big_ts, big_ts + 1], jnp.int64),
+        jnp.asarray([3, 3], jnp.int32),
+        jnp.asarray([80.5, 78.4], jnp.float64),
+    )
+    state, emis = rolling_step(
+        state, keys, cols, jnp.ones(2, bool), combine, kinds, compact
+    )
+    # first-record ts kept exactly for both emissions; max field rolls
+    assert np.asarray(emis[0]).tolist() == [big_ts, big_ts]
+    assert np.asarray(emis[2]).tolist() == [80.5, 80.5]
+    # and the aggregated plane is stored 32-bit while ts planes are not
+    assert state["planes"][0].dtype == jnp.int32   # ts lo
+    assert state["planes"][1].dtype == jnp.int32   # ts hi
+    assert state["planes"][3].dtype == jnp.float32  # compacted usage

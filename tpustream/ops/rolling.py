@@ -17,7 +17,7 @@ throughput is O(B log B) regardless of key skew.
 
 from __future__ import annotations
 
-from typing import Callable, List, Tuple
+from typing import Callable, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +31,11 @@ from .segments import (
 from .wordplanes import pack_words, plane_dtypes, unpack_words
 
 
-def init_rolling_state(key_capacity: int, kinds: List[str], compact32: bool = False) -> dict:
+def init_rolling_state(
+    key_capacity: int,
+    kinds: List[str],
+    compact32: Union[bool, Sequence[bool]] = False,
+) -> dict:
     return {
         "seen": jnp.zeros((key_capacity,), dtype=bool),
         "planes": [
@@ -86,7 +90,7 @@ def rolling_step(
     valid: jnp.ndarray,
     combine: Callable,
     kinds: List[str],
-    compact32: bool = False,
+    compact32: Union[bool, Sequence[bool]] = False,
 ) -> Tuple[dict, Tuple[jnp.ndarray, ...]]:
     """One batch through a rolling aggregate.
 

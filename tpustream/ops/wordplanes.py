@@ -28,6 +28,11 @@ from ..records import BOOL, F64, I64, STR
 
 def _per_leaf(compact32, kinds) -> List[bool]:
     if isinstance(compact32, (list, tuple)):
+        if len(compact32) != len(kinds):
+            raise ValueError(
+                f"per-leaf compact32 has {len(compact32)} entries for "
+                f"{len(kinds)} leaf kinds"
+            )
         return list(compact32)
     return [bool(compact32)] * len(kinds)
 

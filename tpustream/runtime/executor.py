@@ -229,14 +229,8 @@ class Runner:
             cols, valid, ts = self._device_inputs(
                 padded, self.plan.time_characteristic
             )
-            with Stopwatch() as sw:
-                self.state, emissions = self.step(
-                    self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
-                )
-                emissions = jax.device_get(emissions)
-            self.metrics.step_times_s.append(sw.elapsed)
+            self._run_step(cols, valid, ts, wm_lower)
             self.metrics.records_in += int(sub.n)
-            self._dispatch(emissions)
             # with a max_fires_per_step budget, drain deferred window ends
             # BEFORE the next batch can advance the pane ring past them —
             # each drain step still fires at most `budget` ends, so the
@@ -269,6 +263,11 @@ class Runner:
             ts = jnp.zeros((cfg.batch_size,), dtype=jnp.int64)
             self._empty_cache = (cols, valid, ts)
         cols, valid, ts = self._empty_cache
+        self._run_step(cols, valid, ts, wm_lower)
+        self._drain(wm_lower)
+
+    def _run_step(self, cols, valid, ts, wm_lower: int):
+        """One jitted step + emission dispatch (the only step call site)."""
         with Stopwatch() as sw:
             self.state, emissions = self.step(
                 self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
@@ -276,7 +275,6 @@ class Runner:
             emissions = jax.device_get(emissions)
         self.metrics.step_times_s.append(sw.elapsed)
         self._dispatch(emissions)
-        self._drain(wm_lower)
 
     def _drain(self, wm_lower: int):
         """Run empty-batch steps until no window fires remain deferred by
@@ -299,13 +297,7 @@ class Runner:
         cols, valid, ts = self._empty_cache
         max_rounds = self.program.ring.n_fire_candidates + 1
         for _ in range(max_rounds):
-            with Stopwatch() as sw:
-                self.state, emissions = self.step(
-                    self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
-                )
-                emissions = jax.device_get(emissions)
-            self.metrics.step_times_s.append(sw.elapsed)
-            self._dispatch(emissions)
+            self._run_step(cols, valid, ts, wm_lower)
             if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 
