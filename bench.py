@@ -34,7 +34,13 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-B = 1 << 18            # 262144 records/step: the scatter's fixed cost
+B = 1 << 19            # 524288 records/step: batch-size sweep (full
+                       # bench runs) — 131072: 25.9M ev/s @ p99 24 ms;
+                       # 262144: 33.0M @ 40 ms; 524288: 38.2M @ 72 ms.
+                       # The scatter's fixed cost amortizes sublinearly;
+                       # 524288 maximizes throughput while p99 (residency
+                       # 52 ms + 20 ms firing step) stays under the
+                       # 100 ms budget
                        # amortizes sublinearly (full bench: 33M ev/s vs
                        # ~26M at 131072) while batch residency (26 ms)
                        # keeps p99 well inside the 100 ms budget
