@@ -41,9 +41,6 @@ B = 1 << 19            # 524288 records/step: batch-size sweep (full
                        # 524288 maximizes throughput while p99 (residency
                        # 52 ms + 20 ms firing step) stays under the
                        # 100 ms budget
-                       # amortizes sublinearly (full bench: 33M ev/s vs
-                       # ~26M at 131072) while batch residency (26 ms)
-                       # keeps p99 well inside the 100 ms budget
 K = 1 << 20            # 1M keys (BASELINE.json config 5)
 SIM_RATE = 10_000_000  # intrinsic stream rate: fires at real cadence
 BASE_MS = 1_566_957_600_000

@@ -1,0 +1,114 @@
+"""End-to-end allowed-lateness and late-data side-output tests.
+
+The reference documents the three lateness policies at
+chapter3/README.md:195-228: drop (default), ``allowedLateness(T)``
+re-firing the window per allowed-late arrival, and
+``sideOutputLateData(tag)`` routing beyond-lateness records to a tagged
+stream. These pin the snippet's documented behavior end to end.
+"""
+
+import numpy as np
+
+from tpustream import StreamExecutionEnvironment, TimeCharacteristic
+from tpustream.api.output import OutputTag
+from tpustream.api.timeapi import Time
+from tpustream.api.tuples import Tuple2, Tuple3
+from tpustream.api.watermarks import BoundedOutOfOrdernessTimestampExtractor
+from tpustream.api.windows import TumblingEventTimeWindows
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+
+class SecondsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+    def __init__(self, delay_s=0):
+        super().__init__(Time.seconds(delay_s))
+
+    def extract_timestamp(self, line):
+        return int(line.split(" ")[0]) * 1000
+
+
+def parse(line):
+    p = line.split(" ")
+    return Tuple3(int(p[0]), p[1], int(p[2]))
+
+
+def run_job(lines, lateness_s=0, tag=None, **cfg):
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=1, key_capacity=16, **cfg)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines))
+    w = (
+        text.assign_timestamps_and_watermarks(SecondsExtractor())
+        .map(parse)
+        .key_by(1)
+        .window(TumblingEventTimeWindows.of(Time.seconds(60)))
+    )
+    if lateness_s:
+        w = w.allowed_lateness(Time.seconds(lateness_s))
+    if tag is not None:
+        w = w.side_output_late_data(tag)
+    summed = w.reduce(lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2))
+    main = summed.map(lambda t: Tuple2(t.f1, t.f2)).collect()
+    late = (
+        summed.get_side_output(tag).collect() if tag is not None else None
+    )
+    env.execute("lateness")
+    rows = [(t.f0, t.f1) for t in main.items]
+    late_rows = None if late is None else [(t.f0, t.f1, t.f2) for t in late.items]
+    return rows, late_rows
+
+
+BASE = 1_200_000  # epoch seconds, multiple of 60: window [BASE, BASE+60)
+
+
+def test_late_record_dropped_by_default():
+    lines = [
+        f"{BASE + 10} www.a.com 100",
+        f"{BASE + 70} www.a.com 7",    # wm -> BASE+70s: first window fires
+        f"{BASE + 20} www.a.com 900",  # late for the fired window: dropped
+        f"{BASE + 140} www.a.com 5",   # close stream-side windows
+    ]
+    rows, _ = run_job(lines)
+    assert ("www.a.com", 100) in rows          # fired without the late 900
+    assert ("www.a.com", 1000) not in rows
+
+
+def test_allowed_lateness_refires_with_updated_sum():
+    lines = [
+        f"{BASE + 10} www.a.com 100",
+        f"{BASE + 70} www.a.com 7",    # fires [BASE, BASE+60) with sum 100
+        f"{BASE + 20} www.a.com 900",  # within 5 min lateness: REFIRE
+        f"{BASE + 400} www.a.com 5",
+    ]
+    rows, _ = run_job(lines, lateness_s=300)
+    assert ("www.a.com", 100) in rows           # the on-time firing
+    assert ("www.a.com", 1000) in rows          # the per-arrival re-firing
+
+
+def test_beyond_lateness_goes_to_side_output():
+    tag = OutputTag("late-data")
+    lines = [
+        f"{BASE + 10} www.a.com 100",
+        f"{BASE + 70} www.a.com 7",
+        f"{BASE + 20} www.a.com 900",  # beyond lateness 0: side output
+        f"{BASE + 140} www.a.com 5",
+    ]
+    rows, late_rows = run_job(lines, lateness_s=0, tag=tag)
+    assert ("www.a.com", 100) in rows
+    assert ("www.a.com", 1000) not in rows
+    assert (BASE + 20, "www.a.com", 900) in late_rows
+
+
+def test_allowed_lateness_refire_with_fire_budget():
+    # the refire path is budget-exempt: max_fires_per_step=1 must not
+    # swallow the re-firing
+    lines = [
+        f"{BASE + 10} www.a.com 100",
+        f"{BASE + 70} www.a.com 7",
+        f"{BASE + 20} www.a.com 900",
+        f"{BASE + 400} www.a.com 5",
+    ]
+    rows, _ = run_job(lines, lateness_s=300, max_fires_per_step=1)
+    assert ("www.a.com", 100) in rows
+    assert ("www.a.com", 1000) in rows
