@@ -98,3 +98,37 @@ def test_native_parser_throughput():
     assert out is not None and len(out[0]) == len(lines)
     # sanity: well over a million lines/sec on any modern core
     assert rate > 1e6, f"native parse too slow: {rate:.0f} lines/s"
+
+
+def test_multithreaded_parse_identical_to_serial():
+    """tsp_parse_mt must reproduce the serial kernel EXACTLY, including
+    the first-seen intern-id order (chunk order == stream order)."""
+    import numpy as np
+
+    from tpustream.hostparse import PlanEvaluator, trace_host_map
+    from tpustream.jobs.chapter3_bandwidth_eventtime import parse
+    from tpustream.records import STR, StringTable
+
+    lines = [
+        f"2019-08-28T10:{(j // 60) % 60:02d}:{j % 60:02d} "
+        f"www.ch{(j * 7) % 199}.com {100 + j % 97}"
+        for j in range(60_000)
+    ]
+    data = ("\n".join(lines) + "\n").encode()
+
+    def run(threads):
+        plan = trace_host_map(parse)
+        tables = [StringTable() if k == STR else None for k in plan.kinds]
+        ev = PlanEvaluator(plan.outputs, tables)
+        if ev._native is None:
+            pytest.skip("native parser unavailable")
+        cols, bad = ev._native.parse(data, len(lines), threads=threads)
+        tbl = [t for t in ev._native.tables if t is not None][0]
+        return [np.asarray(c) for c in cols], bad, list(tbl.py_table._to_str)
+
+    cols1, bad1, strs1 = run(1)
+    cols4, bad4, strs4 = run(4)
+    assert bad1 == bad4 == 0
+    assert strs1 == strs4
+    for c1, c4 in zip(cols1, cols4):
+        assert np.array_equal(c1, c4)

@@ -32,7 +32,7 @@ def _build() -> bool:
     src = os.path.join(_HERE, "fastparse.cpp")
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", _SO],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", _SO],
             check=True,
             capture_output=True,
         )
@@ -81,6 +81,14 @@ def _load():
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.tsp_parse.restype = ctypes.c_int64
+        try:
+            lib.tsp_parse_mt.argtypes = lib.tsp_parse.argtypes + [
+                ctypes.c_int32
+            ]
+            lib.tsp_parse_mt.restype = ctypes.c_int64
+        except AttributeError:
+            # stale pre-MT .so: keep the graceful-fallback contract
+            return None
         _lib = lib
         return _lib
 
@@ -147,7 +155,21 @@ class NativeParser:
             *[t.ptr if t is not None else None for t in self.tables]
         )
 
-    def parse(self, data: bytes, max_rows: int):
+    def parse(self, data: bytes, max_rows: int, threads: Optional[int] = None):
+        """Parse into fresh numpy columns. ``threads`` > 1 uses the
+        chunked multi-threaded kernel (identical output, including
+        intern-id assignment order); default: TPUSTREAM_PARSE_THREADS or
+        the core count, engaged only for buffers >= 1 MiB."""
+        if threads is None:
+            try:
+                threads = int(
+                    os.environ.get(
+                        "TPUSTREAM_PARSE_THREADS", os.cpu_count() or 1
+                    )
+                )
+            except ValueError:
+                threads = os.cpu_count() or 1
+        threads = max(1, min(int(threads), 64))
         n = len(self.specs)
         cols = []
         ptrs = (ctypes.c_void_p * n)()
@@ -161,7 +183,7 @@ class NativeParser:
             cols.append(c)
             ptrs[i] = c.ctypes.data_as(ctypes.c_void_p)
         bad = ctypes.c_int64(0)
-        rows = self._lib.tsp_parse(
+        rows = self._lib.tsp_parse_mt(
             data,
             len(data),
             self.sep,
@@ -173,6 +195,7 @@ class NativeParser:
             ptrs,
             max_rows,
             ctypes.byref(bad),
+            max(1, threads),
         )
         out = []
         for c, t in zip(cols, self.tables):
