@@ -132,3 +132,4 @@ def test_multithreaded_parse_identical_to_serial():
     assert strs1 == strs4
     for c1, c4 in zip(cols1, cols4):
         assert np.array_equal(c1, c4)
+

@@ -1,0 +1,47 @@
+"""Socket-source failure semantics: connect and mid-stream errors must
+fail the job on the MAIN thread (Flink's socket source throws
+ConnectException / IOExceptions too), never masquerade as a clean
+end-of-stream."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.config import StreamConfig
+
+
+def test_connect_failure_raises_clearly():
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=4))
+    text = env.socket_text_stream("127.0.0.1", 1)  # nothing listens on 1
+    text.print()
+    with pytest.raises(RuntimeError, match="could not connect"):
+        env.execute("no-server")
+
+
+def test_midstream_reset_fails_the_job():
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def server():
+        conn, _ = srv.accept()
+        conn.sendall(b"1566208860 10.8.22.1 cpu1 99.2\n")
+        time.sleep(0.5)
+        # RST instead of FIN: SO_LINGER with zero timeout
+        conn.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+        conn.close()
+        srv.close()
+
+    threading.Thread(target=server, daemon=True).start()
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=4, max_batch_delay_ms=100.0)
+    )
+    text = env.socket_text_stream("127.0.0.1", port)
+    text.print()
+    with pytest.raises(RuntimeError, match="lost the connection"):
+        env.execute("reset-mid-stream")

@@ -123,6 +123,7 @@ class SocketTextSource(Source):
         self.idle_tick_ms = idle_tick_ms
         self._queue: "queue.Queue" = queue.Queue(maxsize=1 << 16)
         self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
 
     def _reader(self) -> None:
         # lines are stamped with the wall clock AT READ TIME (Flink's
@@ -130,26 +131,47 @@ class SocketTextSource(Source):
         # first jit compile), queued records keep their true arrival
         # times instead of inheriting the post-stall clock
         try:
-            with socket.create_connection((self.host, self.port)) as sock:
-                buf = b""
-                while True:
-                    chunk = sock.recv(1 << 16)
-                    if not chunk:
-                        break
-                    buf += chunk
-                    while b"\n" in buf:
-                        line, buf = buf.split(b"\n", 1)
-                        self._queue.put(
-                            (line.decode("utf-8", "replace").rstrip("\r"),
-                             int(_time.time() * 1000))
-                        )
-                if buf:
-                    self._queue.put(
-                        (buf.decode("utf-8", "replace").rstrip("\r"),
-                         int(_time.time() * 1000))
-                    )
+            try:
+                sock_cm = socket.create_connection((self.host, self.port))
+            except OSError as e:
+                # surface connect failures on the MAIN thread (Flink's
+                # socket source fails the job with ConnectException too)
+                self._error = RuntimeError(
+                    f"socket source could not connect to "
+                    f"{self.host}:{self.port}: {e} — start a line server "
+                    f"first, e.g. `nc -lk {self.port}`"
+                )
+                return
+            self._read_stream(sock_cm)
+        except OSError as e:
+            # mid-stream failures (e.g. connection reset) also fail the
+            # job instead of masquerading as a clean end-of-stream
+            self._error = RuntimeError(
+                f"socket source lost the connection to "
+                f"{self.host}:{self.port}: {e}"
+            )
         finally:
             self._queue.put(None)  # sentinel: EOF
+
+    def _read_stream(self, sock_cm) -> None:
+        with sock_cm as sock:
+            buf = b""
+            while True:
+                chunk = sock.recv(1 << 16)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    self._queue.put(
+                        (line.decode("utf-8", "replace").rstrip("\r"),
+                         int(_time.time() * 1000))
+                    )
+            if buf:
+                self._queue.put(
+                    (buf.decode("utf-8", "replace").rstrip("\r"),
+                     int(_time.time() * 1000))
+                )
 
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         self._thread = threading.Thread(target=self._reader, daemon=True)
@@ -168,6 +190,8 @@ class SocketTextSource(Source):
                 except queue.Empty:
                     break
                 if item is None:
+                    if self._error is not None:
+                        raise self._error
                     done = True
                     break
                 lines.append(item[0])
