@@ -234,3 +234,38 @@ def test_rolling_compact32_keeps_passthrough_fields_exact():
     assert state["planes"][0].dtype == jnp.int32   # ts lo
     assert state["planes"][1].dtype == jnp.int32   # ts hi
     assert state["planes"][3].dtype == jnp.float32  # compacted usage
+
+
+def test_aggregate_fast_path_matches_exact_approximately():
+    """The windowed-average AGGREGATE (acc = count int64 + sum float64,
+    both algebraic adds) takes the scatter-reduce fast path under
+    acc_dtype=float32; results match the exact path to f32 precision."""
+    from tpustream import StreamExecutionEnvironment
+    from tpustream.config import StreamConfig
+    from tpustream.jobs.chapter2_avg import build
+    from tpustream.runtime.plan import build_plan
+    from tpustream.runtime.sources import AdvanceProcessingTime, ReplaySource
+    from tpustream.runtime.step import build_program
+
+    rng = np.random.default_rng(11)
+    lines = [
+        f"{1566208860 + i} 10.8.22.{i % 5} cpu{i % 3} "
+        f"{rng.integers(1, 1000) / 10.0}"
+        for i in range(400
+        )
+    ] + [AdvanceProcessingTime(300_000)]
+
+    def run(acc_dtype):
+        cfg = StreamConfig(batch_size=64, key_capacity=16, acc_dtype=acc_dtype)
+        env = StreamExecutionEnvironment(cfg)
+        text = env.add_source(ReplaySource(lines))
+        h = build(env, text).collect()
+        prog = build_program(build_plan(env, env._sinks), cfg)
+        env.execute("avg")
+        return sorted(float(x) for x in h.items), prog
+
+    exact, p_exact = run("float64")
+    fast, p_fast = run("float32")
+    assert not p_exact.fast_reduce and p_fast.fast_reduce
+    assert len(exact) == len(fast) > 0
+    np.testing.assert_allclose(fast, exact, rtol=1e-5)
