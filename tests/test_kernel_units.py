@@ -199,7 +199,7 @@ def test_rolling_matches_reference_across_batches(kind):
 
     want = _rolling_reference(kind, 1, batches, 2)
     for (keys, cols, valid), w in zip(batches, want):
-        state, emis = rolling_step(
+        state, emis_sorted, sv, sk, inv = rolling_step(
             state,
             jnp.asarray(keys),
             tuple(jnp.asarray(c) for c in cols),
@@ -207,9 +207,11 @@ def test_rolling_matches_reference_across_batches(kind):
             combine,
             ["str", "f64"],
         )
+        inv = np.asarray(inv)
         for c in range(2):
+            arrival = np.asarray(emis_sorted[c])[inv]
             np.testing.assert_allclose(
-                np.asarray(emis[c])[valid], w[c][valid], rtol=1e-5
+                arrival[valid], w[c][valid], rtol=1e-5
             )
 
 

@@ -91,10 +91,16 @@ def rolling_step(
     combine: Callable,
     kinds: List[str],
     compact32: Union[bool, Sequence[bool]] = False,
-) -> Tuple[dict, Tuple[jnp.ndarray, ...]]:
+) -> Tuple[dict, Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batch through a rolling aggregate.
 
-    Returns (new_state, per-record emission columns in arrival order).
+    Returns (new_state, emission columns in SORTED order, sorted-order
+    validity, sorted raw keys, inv) where ``inv[j]`` is the sorted
+    position of arrival row j. The sorted RAW key array is returned
+    because the emitted key field is not key-invariant when the combiner
+    aggregates the keyed column itself (e.g. keyBy(p).sum(p)). The device does NOT un-permute the emissions: the inverse
+    gathers cost more than the whole state update on v5e (measured), so
+    the host applies ``inv`` with a numpy gather off the critical path.
     """
     K = state["seen"].shape[0]
     perm, sk, sv, seg_starts = sort_by_key(keys, valid, max_key=K)
@@ -125,5 +131,4 @@ def rolling_step(
     new_seen = state["seen"].at[idx].set(True, mode="drop", unique_indices=True)
 
     inv = inverse_permutation(perm)
-    emissions = tuple(e[inv] for e in emis_sorted)
-    return {"seen": new_seen, "planes": new_planes}, emissions
+    return {"seen": new_seen, "planes": new_planes}, emis_sorted, sv, sk, inv

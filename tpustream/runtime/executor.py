@@ -315,7 +315,15 @@ class Runner:
         main = emissions.get("main")
         if main is not None:
             mask = np.asarray(main["mask"])
-            sel = np.nonzero(mask)[0]
+            order = main.get("order")
+            if order is not None:
+                # device emitted rows in its internal (sorted) order;
+                # order[j] is arrival row j's position — un-permute HERE,
+                # off the device critical path (numpy gather)
+                order = np.asarray(order)
+                sel = order[np.nonzero(mask[order])[0]]
+            else:
+                sel = np.nonzero(mask)[0]
             if sel.size:
                 cols = [np.asarray(c)[sel] for c in main["cols"]]
                 subtask = main.get("subtask")

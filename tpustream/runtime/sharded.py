@@ -76,6 +76,9 @@ class _ShardedMixin:
         idx = jax.lax.axis_index(AXIS).astype(jnp.int32)
         return local_ids.astype(jnp.int32) * self.n_shards + idx
 
+    def _row_offset(self, n_local_rows: int):
+        return jax.lax.axis_index(AXIS).astype(jnp.int32) * n_local_rows
+
     def _sharded_jit(self):
         state = self.init_state()
         state_specs = self.state_specs(state)

@@ -71,6 +71,12 @@ class BaseProgram:
     n_shards = 1
     vary_axes: tuple = ()
 
+    def _row_offset(self, n_local_rows: int):
+        """Offset of this shard's emission rows in the concatenated
+        output (0 on one chip; shard_index * local_rows on a mesh) so
+        host-side ``order`` indices address the stacked arrays."""
+        return jnp.zeros((), dtype=jnp.int32)
+
     def _global_max(self, x):
         return x
 
@@ -82,6 +88,11 @@ class BaseProgram:
 
     def _local_keys(self, key_col):
         return key_col.astype(jnp.int32)
+
+    def _global_key_ids(self, local_ids):
+        """Local state row -> global key id (identity on one chip; the
+        sharded mixin interleaves by shard)."""
+        return local_ids.astype(jnp.int32)
 
 
 class StatelessProgram(BaseProgram):
@@ -163,15 +174,27 @@ class RollingProgram(BaseProgram):
         mid_cols, mask, ts, _ = self._exchange(mid_cols, mask, ts)
         gkeys = mid_cols[self.key_pos]
         keys = self._local_keys(gkeys)
-        new_state, emitted = rolling_ops.rolling_step(
+        new_state, emitted_sorted, sv, sk, inv = rolling_ops.rolling_step(
             state, keys, tuple(mid_cols), mask, self.combine,
             self.mid_kinds, self._compact32,
         )
-        out_cols, out_mask = self.post_chain.apply(list(emitted), mask)
+        # emissions stay in sorted order; the host un-permutes via
+        # emissions["order"] (device-side inverse gathers dominate the
+        # rolling step cost on v5e)
+        out_cols, out_mask = self.post_chain.apply(list(emitted_sorted), sv)
         n_shards = max(1, self.cfg.parallelism)
-        subtask = gkeys.astype(jnp.int32) % n_shards
+        # subtask from the sorted RAW key (aggregation-invariant), mapped
+        # back to the global id space
+        subtask = self._global_key_ids(
+            jnp.where(sv, sk, 0).astype(jnp.int32)
+        ) % n_shards
         return new_state, {
-            "main": {"mask": out_mask, "cols": tuple(out_cols), "subtask": subtask}
+            "main": {
+                "mask": out_mask,
+                "cols": tuple(out_cols),
+                "subtask": subtask,
+                "order": self._row_offset(inv.shape[0]) + inv.astype(jnp.int32),
+            }
         }
 
 

@@ -305,14 +305,9 @@ class WindowProgram(BaseProgram):
     def _acc_dtype(self, kind: str):
         return np.int32 if kind == STR else NUMPY_DTYPES[kind]
 
-    # -- SPMD hooks (shared ones live on BaseProgram) -------------------
-    def _global_key_ids(self, local_ids):
-        """Local state row -> global key id (identity on one chip; the
-        sharded mixin interleaves by shard). Both the combiner's
-        reconstructed key leaf and emissions must use GLOBAL ids so the
-        sharded program matches the single-chip one."""
-        return local_ids.astype(jnp.int32)
-
+    # -- SPMD hooks (shared ones live on BaseProgram; the combiner's
+    # reconstructed key leaf and emissions use GLOBAL ids so the sharded
+    # program matches the single-chip one) ------------------------------
     def _emission_keys(self):
         return self._global_key_ids(
             jnp.arange(self.local_key_capacity, dtype=jnp.int32)
