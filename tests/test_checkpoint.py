@@ -127,3 +127,25 @@ def test_load_latest_from_directory(tmp_path):
     ck = load_checkpoint(str(ckdir))  # directory resolves to newest snapshot
     resumed = run_job(build, lines, restore=str(ckdir))
     assert resumed == full[ck.emitted :]
+
+
+def test_event_time_window_resume_fast_path(tmp_path):
+    """Checkpoint/resume of the 32-bit fast-path window state (identity-
+    initialized scatter-reduce planes + fired_through/pending bookkeeping)
+    restores mid-window exactly, budget active."""
+    from tpustream.api.timeapi import TimeCharacteristic
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+
+    lines = [
+        f"2019-08-28T10:{m:02d}:{s:02d} www.ch{(m * 7 + s) % 5}.com {100 + m * 10 + s}"
+        for m in range(8)
+        for s in (0, 20, 40)
+    ]
+    resume_suffix_check(
+        build,
+        lines,
+        tmp_path,
+        time_char=TimeCharacteristic.EventTime,
+        acc_dtype="int32",
+        max_fires_per_step=1,
+    )
