@@ -68,12 +68,17 @@ def main():
     wm0 = jnp.asarray(-(2**62), jnp.int64)
     rec_per_ms = SIM_RATE // 1000
 
+    def stream_hash(i, n):
+        """Deterministic per-record mix shared by every phase's stream
+        generator (Knuth multiplicative hash + xor-shift)."""
+        g = i * n + jnp.arange(n, dtype=jnp.int64)
+        h = g * 2654435761
+        return g, h ^ (h >> 29)
+
     def gen(i):
         """Batch i of the synthetic stream: uniform keys, ~1% alerting
         (low-flow) channels, up to 10 s of bounded out-of-orderness."""
-        g = i * B + jnp.arange(B, dtype=jnp.int64)
-        h = g * 2654435761
-        h = h ^ (h >> 29)
+        g, h = stream_hash(i, B)
         keys = (h % K).astype(jnp.int32)
         alerting = (keys & 127) == 0
         flow = jnp.where(alerting, 1, 1_000_000)
@@ -209,6 +214,60 @@ def main():
         f"through this env's tunnel"
     )
 
+    # ---- Phase D: rolling-aggregate config (BASELINE.json config 2) -----
+    # chapter2-style keyed running max at 1M keys, measured with the same
+    # chained-scan methodology; failures here never sink the headline
+    rolling_rate = None
+    try:
+        from tpustream.ops import rolling as R
+
+        BR = 1 << 17
+        KINDS = ["str", "str", "f64"]
+        compact = [False, False, True]
+        combine = R.make_combiner("max", 2)
+
+        def rgen(i):
+            _, h = stream_hash(i, BR)
+            return (h % K).astype(jnp.int32), (
+                (h % K).astype(jnp.int32),
+                (h % 8).astype(jnp.int32),
+                (h % 10000).astype(jnp.float64) / 100.0,
+            )
+
+        def rmulti(rstate, tot, i):
+            def body(carry, _):
+                rstate, tot, i = carry
+                keys, rcols = rgen(i)
+                rstate, emis, sv, sk, inv = R.rolling_step(
+                    rstate, keys, rcols, jnp.ones(BR, bool), combine,
+                    KINDS, compact,
+                )
+                return (rstate, tot + emis[2].sum(), i + 1), None
+
+            (rstate, tot, i), _ = jax.lax.scan(
+                body, (rstate, tot, i), None, length=100
+            )
+            return rstate, tot, i
+
+        rmulti_j = jax.jit(rmulti, donate_argnums=0)
+        rstate = R.init_rolling_state(K, KINDS, compact)
+        rtot = jnp.asarray(0.0, jnp.float64)
+        ri = jnp.asarray(0, jnp.int64)
+        rstate, rtot, ri = rmulti_j(rstate, rtot, ri)
+        _ = np.asarray(rtot)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            rstate, rtot, ri = rmulti_j(rstate, rtot, ri)
+        _ = np.asarray(rtot)
+        rdt = time.perf_counter() - t0
+        rolling_rate = 300 * BR / rdt
+        log(
+            f"phase D: rolling max (1M keys): {rolling_rate/1e6:.1f}M "
+            f"events/s/chip ({rdt/300*1e3:.2f} ms/step)"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase D skipped: {e}")
+
     # ---- Phase C: native parse throughput -------------------------------
     parse_rate = None
     try:
@@ -246,6 +305,7 @@ def main():
                     "late_dropped": total_late,
                     "alert_overflow": alert_ovf,
                     "evicted_unfired": evicted,
+                    "rolling_max_events_per_s": round(rolling_rate or 0),
                     "native_parse_lines_per_s": round(parse_rate or 0),
                 },
             }
