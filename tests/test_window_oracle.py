@@ -1,0 +1,180 @@
+"""Randomized differential test: the windowed-aggregation program vs a
+record-at-a-time Flink-semantics oracle.
+
+The oracle replays the stream one batch at a time, maintaining the
+bounded-out-of-orderness watermark (max_seen - delay, monotone —
+chapter3/README.md:380-396), dropping records whose LAST window already
+fired (late, chapter3/README.md:195-213), and firing every slide-aligned
+window end the watermark crosses with the sum of its live records.
+Random keys, timestamps, jitter, window geometry, batch sizes — both the
+exact sorted-merge path and the 32-bit scatter-reduce fast path must
+reproduce the oracle's (key, window_end, sum) multiset exactly.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpustream import StreamExecutionEnvironment, TimeCharacteristic
+from tpustream.api.timeapi import Time
+from tpustream.api.tuples import Tuple2, Tuple3
+from tpustream.api.watermarks import BoundedOutOfOrdernessTimestampExtractor
+from tpustream.api.windows import SlidingEventTimeWindows
+from tpustream.config import StreamConfig
+from tpustream.records import StringTable
+from tpustream.runtime.plan import build_plan
+from tpustream.runtime.sources import ReplaySource
+from tpustream.runtime.step import build_program
+
+BASE = 1_700_000_000_000  # ms
+
+
+def build_program_for(size_s, slide_s, delay_s, acc_dtype, key_capacity, batch):
+    class Ext(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.seconds(delay_s))
+
+        def extract_timestamp(self, line):
+            return int(line.split(" ")[0])
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(
+            batch_size=batch,
+            key_capacity=key_capacity,
+            alert_capacity=4096,
+            acc_dtype=acc_dtype,
+        )
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource([]))
+    (
+        text.assign_timestamps_and_watermarks(Ext())
+        .map(lambda l: Tuple3(int(l.split(" ")[0]), l.split(" ")[1], int(l.split(" ")[2])))
+        .key_by(1)
+        .window(
+            SlidingEventTimeWindows.of(
+                Time.seconds(size_s), Time.seconds(slide_s)
+            )
+        )
+        .reduce(lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2))
+        .map(lambda t: Tuple2(t.f1, t.f2))  # like ch3: drops the first-seen
+        .collect()                          # ts so only the sum is stored
+    )
+    plan = build_plan(env, env._sinks)
+    if not plan.record_kinds:
+        plan.record_kinds.extend(["i64", "str", "i64"])
+        plan.tables.extend([None, StringTable(), None])
+    return build_program(plan, env.config), plan
+
+
+def oracle(batches, size_ms, slide_ms, delay_ms):
+    """Record-at-a-time reference. Returns the multiset of
+    (key, window_end, sum) fired across the whole stream + EOS flush."""
+    wm = -(2**62)
+    live = []  # (ts, key, flow) records accepted so far
+    fired = set()  # window ends already fired (fire once per end)
+    out = []
+
+    def last_end(ts):
+        return (ts + size_ms) // slide_ms * slide_ms
+
+    def fire_through(new_wm):
+        # every aligned end e with e-1 <= new_wm, not yet fired, that
+        # could contain data
+        if not live:
+            ends = []
+        else:
+            lo = min(ts for ts, _, _ in live)
+            hi = max(ts for ts, _, _ in live)
+            first = (lo // slide_ms) * slide_ms + slide_ms
+            ends = [
+                e
+                for e in range(first, last_end(hi) + slide_ms, slide_ms)
+                if e - 1 <= new_wm and e not in fired
+            ]
+        for e in sorted(ends):
+            fired.add(e)
+            sums = {}
+            for ts, k, f in live:
+                if e - size_ms <= ts < e:
+                    sums[k] = sums.get(k, 0) + f
+            for k, s in sums.items():
+                out.append((k, e, s))
+
+    for batch in batches:
+        wm_old = wm
+        mx = max((ts for ts, _, _ in batch), default=None)
+        if mx is not None:
+            wm = max(wm, mx - delay_ms)
+        for ts, k, f in batch:
+            if last_end(ts) - 1 <= wm_old:
+                continue  # late: all its windows fired
+            live.append((ts, k, f))
+        fire_through(wm)
+    fire_through(2**62)  # EOS flush
+    return sorted(out)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("acc_dtype", ["float64", "int32"])
+def test_window_program_matches_oracle(seed, acc_dtype):
+    rng = np.random.default_rng(seed)
+    size_s = int(rng.choice([20, 30, 60]))
+    slide_s = int(rng.choice([5, 10]))
+    delay_s = int(rng.choice([0, 10, 30]))
+    n_keys = int(rng.choice([3, 8, 16]))
+    batch = 64
+    n_batches = 10
+    size_ms, slide_ms, delay_ms = size_s * 1000, slide_s * 1000, delay_s * 1000
+
+    prog, plan = build_program_for(
+        size_s, slide_s, delay_s, acc_dtype, max(16, n_keys), batch
+    )
+    assert prog.fast_reduce == (acc_dtype == "int32")
+    step = jax.jit(prog._step)
+    state = prog.init_state()
+
+    t = BASE
+    batches = []
+    for _ in range(n_batches):
+        ts = t + rng.integers(0, 20_000, batch) - rng.integers(0, delay_ms + 15_000, batch)
+        keys = rng.integers(0, n_keys, batch).astype(np.int32)
+        flow = rng.integers(1, 1000, batch)
+        batches.append(list(zip(ts.tolist(), keys.tolist(), flow.tolist())))
+        t += 15_000
+
+    got = []
+
+    def run_batch(recs, wm_lower, valid=True):
+        nonlocal state
+        ts = np.asarray([r[0] for r in recs], np.int64)
+        cols = (
+            jnp.asarray(ts),
+            jnp.asarray([r[1] for r in recs], np.int32),
+            jnp.asarray([r[2] for r in recs], np.int64),
+        )
+        state, em = step(
+            state,
+            cols,
+            jnp.full(len(recs), valid, bool),
+            jnp.asarray(ts),
+            jnp.asarray(wm_lower, jnp.int64),
+        )
+        m = np.asarray(em["main"]["mask"])
+        kc = np.asarray(em["main"]["cols"][0])
+        sc = np.asarray(em["main"]["cols"][1])
+        ec = np.asarray(em["main"]["window_end"])
+        for j in np.nonzero(m)[0]:
+            got.append((int(kc[j]), int(ec[j]), int(sc[j])))
+
+    for b in batches:
+        run_batch(b, -(2**62))
+    # EOS: MAX watermark flush with an empty (all-invalid) batch
+    run_batch([(0, 0, 0)] * batch, 2**62, valid=False)
+
+    want = oracle(batches, size_ms, slide_ms, delay_ms)
+    assert sorted(got) == want, (
+        f"seed={seed} acc={acc_dtype} size={size_s}s slide={slide_s}s "
+        f"delay={delay_s}s: {len(got)} fired vs oracle {len(want)}"
+    )
