@@ -63,6 +63,15 @@ class StreamConfig:
     # Set a factor to shrink send buffers when keys are known-uniform;
     # overflow is then counted in state["exchange_overflow"].
 
+    # -- failure policy -----------------------------------------------------
+    strict_overflow: bool = False
+    # When True the job FAILS (RuntimeError at flush / end of stream)
+    # if any lossy counter went nonzero: exchange_overflow (keyBy shuffle
+    # dropped records — Flink never does), buffer_overflow (a full-window
+    # process() buffer truncated, which would silently corrupt e.g. a
+    # median), alert_overflow, or evicted_unfired. Default False keeps
+    # the counters observable in JobResult.summary() without failing.
+
     # -- misc ---------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_batches: int = 0  # 0 = disabled

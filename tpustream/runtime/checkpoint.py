@@ -31,7 +31,9 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: window/process/session state gained device-side metric counter
+# leaves (window_fires / late_dropped), changing the snapshot treedef
+FORMAT_VERSION = 2
 _META_KEY = "__meta__"
 
 
@@ -181,7 +183,12 @@ def load_checkpoint(path: str) -> Checkpoint:
     with np.load(path) as z:
         meta = json.loads(bytes(z[_META_KEY]).decode())
         if meta.get("version") != FORMAT_VERSION:
-            raise ValueError(f"unsupported checkpoint version {meta.get('version')}")
+            raise ValueError(
+                f"checkpoint format version {meta.get('version')} does not "
+                f"match this build's {FORMAT_VERSION} — the snapshot was "
+                "written by a different tpustream version; restart the job "
+                "from the source instead of resuming"
+            )
         names = sorted(k for k in z.files if k.startswith("L"))
         leaves = [z[k] for k in names]
     return Checkpoint(

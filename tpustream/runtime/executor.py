@@ -209,9 +209,11 @@ class Runner:
             )
         return cols, valid, ts
 
-    def feed(self, batch: Batch, wm_lower: int):
+    def feed(self, batch: Batch, wm_lower: int, t_batch: Optional[float] = None):
         cfg = self.cfg
         self._check_capacity()
+        if t_batch is None:
+            t_batch = time.perf_counter()
         for start in range(0, batch.n, cfg.batch_size):
             sub = Batch(
                 min(cfg.batch_size, batch.n - start),
@@ -229,15 +231,15 @@ class Runner:
             cols, valid, ts = self._device_inputs(
                 padded, self.plan.time_characteristic
             )
-            self._run_step(cols, valid, ts, wm_lower)
+            self._run_step(cols, valid, ts, wm_lower, t_batch)
             self.metrics.records_in += int(sub.n)
             # with a max_fires_per_step budget, drain deferred window ends
             # BEFORE the next batch can advance the pane ring past them —
             # each drain step still fires at most `budget` ends, so the
             # per-step latency bound holds while no fire is ever lost
-            self._drain(wm_lower)
+            self._drain(wm_lower, t_batch)
 
-    def flush(self, wm_lower: int):
+    def flush(self, wm_lower: int, t_batch: Optional[float] = None):
         """Advance time with an empty batch (processing-time tick / EOS).
 
         Window programs fire at most ``max_fires_per_step`` window ends
@@ -248,6 +250,8 @@ class Runner:
             "rolling_reduce",
         ):
             return
+        if t_batch is None:
+            t_batch = time.perf_counter()
         cfg = self.cfg
         if self._empty_cache is None:
             cols = tuple(
@@ -263,10 +267,10 @@ class Runner:
             ts = jnp.zeros((cfg.batch_size,), dtype=jnp.int64)
             self._empty_cache = (cols, valid, ts)
         cols, valid, ts = self._empty_cache
-        self._run_step(cols, valid, ts, wm_lower)
-        self._drain(wm_lower)
+        self._run_step(cols, valid, ts, wm_lower, t_batch)
+        self._drain(wm_lower, t_batch)
 
-    def _run_step(self, cols, valid, ts, wm_lower: int):
+    def _run_step(self, cols, valid, ts, wm_lower: int, t_batch=None):
         """One jitted step + emission dispatch (the only step call site)."""
         with Stopwatch() as sw:
             self.state, emissions = self.step(
@@ -274,9 +278,43 @@ class Runner:
             )
             emissions = jax.device_get(emissions)
         self.metrics.step_times_s.append(sw.elapsed)
-        self._dispatch(emissions)
+        self._dispatch(emissions, t_batch)
 
-    def _drain(self, wm_lower: int):
+    def finalize_metrics(self):
+        """Fold the device-side cumulative counters into Metrics (one
+        scalar fetch per job, never on the per-batch hot path)."""
+        if not isinstance(self.state, dict):
+            return
+        names = (
+            "window_fires", "late_dropped", "alert_overflow",
+            "exchange_overflow", "buffer_overflow", "evicted_unfired",
+        )
+        present = {n: self.state[n] for n in names if n in self.state}
+        if not present:
+            return
+        vals = jax.device_get(present)
+        for n, val in vals.items():
+            # window_fires for the host-evaluated process path is counted
+            # host-side; device programs count on device — += merges both
+            setattr(self.metrics, n, getattr(self.metrics, n) + int(val))
+
+    def check_strict(self):
+        """strict_overflow: fail loudly if any lossy counter is nonzero
+        (Flink's shuffle/state never silently drops records). Reads the
+        counters finalize_metrics() already folded — call it first."""
+        if not self.cfg.strict_overflow:
+            return
+        bad = {n: v for n, v in self.metrics.overflow_counts().items() if v}
+        if bad:
+            raise RuntimeError(
+                "strict_overflow: records were lost or truncated: "
+                + ", ".join(f"{n}={v}" for n, v in sorted(bad.items()))
+                + " — raise the relevant capacity "
+                "(alert_capacity / exchange_capacity_factor / "
+                "process_buffer_capacity / pane_ring_slack)"
+            )
+
+    def _drain(self, wm_lower: int, t_batch=None):
         """Run empty-batch steps until no window fires remain deferred by
         the max_fires_per_step budget (no-op for programs without one).
 
@@ -292,26 +330,29 @@ class Runner:
         if pending is None or int(jax.device_get(pending)) == 0:
             return
         if self._empty_cache is None:
-            self.flush(wm_lower)  # builds the cache and runs one round
+            # builds the cache and runs one round
+            self.flush(wm_lower, t_batch)
             return
         cols, valid, ts = self._empty_cache
         max_rounds = self.program.ring.n_fire_candidates + 1
         for _ in range(max_rounds):
-            self._run_step(cols, valid, ts, wm_lower)
+            self._run_step(cols, valid, ts, wm_lower, t_batch)
             if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 
-    def _dispatch(self, emissions):
+    def _dispatch(self, emissions, t_batch=None):
+        emitted_before = self.metrics.records_emitted
         fire_info = emissions.get("process_fire")
         if fire_info is not None:
             def emit(item, subtask):
                 for sink in self.sinks:
                     sink.emit(item, subtask=subtask)
 
-            n = self.program.evaluate_fires(
+            n, fired = self.program.evaluate_fires(
                 self.state, fire_info, self.plan.device_post, emit
             )
             self.metrics.records_emitted += n
+            self.metrics.window_fires += fired
         main = emissions.get("main")
         if main is not None:
             mask = np.asarray(main["mask"])
@@ -336,13 +377,19 @@ class Runner:
         late = emissions.get("late")
         if late is not None and self.side_sinks:
             self._dispatch_late(late)
+        if t_batch is not None and self.metrics.records_emitted > emitted_before:
+            self.metrics.emit_latencies_s.append(
+                time.perf_counter() - t_batch
+            )
 
     def _dispatch_late(self, late):
+        # late-drop COUNTING happens on device (state["late_dropped"], so
+        # jobs without a side output still observe drops); this path only
+        # feeds the configured side sinks
         mask = np.asarray(late["mask"])
         sel = np.nonzero(mask)[0]
         if not sel.size:
             return
-        self.metrics.late_dropped += int(sel.size)
         cols = [np.asarray(c)[sel] for c in late["cols"]]
         fmt = EmissionFormatter(
             self.program.mid_kinds, self.program.mid_tables
@@ -367,7 +414,6 @@ def execute_job(env, sink_nodes) -> JobResult:
     runner: Optional[Runner] = None
     proc_now = 0
     domain = plan.time_characteristic
-    bounded = plan.source.is_bounded()
 
     # -- checkpoint restore (chapter3/README.md:454-456 teased surface) ----
     skip_lines = 0
@@ -412,7 +458,7 @@ def execute_job(env, sink_nodes) -> JobResult:
         if batch is not None:
             if runner is None:
                 runner = Runner(plan, cfg, metrics)
-            runner.feed(batch, wm_lower_for_records(wm_hint))
+            runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
         elif (
             sb.advance_proc_to is not None
             and runner is not None
@@ -439,12 +485,18 @@ def execute_job(env, sink_nodes) -> JobResult:
         if sb.final:
             break
 
-    if runner is not None and bounded:
+    # stream end (bounded replay OR a socket/iterator source closing):
+    # Flink's source-function return emits a Long.MAX_VALUE watermark that
+    # fires every remaining event-time window — match that here
+    if runner is not None:
         if domain == TimeCharacteristic.ProcessingTime:
             runner.flush(proc_now - 1)
         else:
-            # bounded event-time stream end: MAX watermark fires all windows
             runner.flush(MAX_WATERMARK)
+
+    if runner is not None:
+        runner.finalize_metrics()
+        runner.check_strict()
 
     env.metrics = metrics
     return JobResult(metrics)

@@ -1,9 +1,16 @@
-"""Per-job metrics: batches, records, emissions, step latencies.
+"""Per-job metrics: batches, records, emissions, latencies, overflow.
 
 The reference has no observability beyond the print sink
 (SURVEY.md §5 "tracing/profiling: none in-repo"); this provides the
 structured per-batch counters SURVEY.md asks the build to add, plus an
 optional ``jax.profiler`` trace hook.
+
+Counter provenance: ``window_fires``/``late_dropped``/overflow counters
+are accumulated ON DEVICE inside the jitted step (so they are exact even
+when the executor never inspects per-step emissions, e.g. a job without
+a late side output) and folded into this object once per job by
+``Runner.finalize_metrics``. ``records_*`` and latency samples are
+host-side.
 """
 
 from __future__ import annotations
@@ -13,6 +20,13 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
 @dataclass
 class Metrics:
     batches: int = 0
@@ -20,22 +34,46 @@ class Metrics:
     records_emitted: int = 0
     window_fires: int = 0
     late_dropped: int = 0
+    # device-side overflow/loss counters (see StreamConfig.strict_overflow)
+    alert_overflow: int = 0
+    exchange_overflow: int = 0
+    buffer_overflow: int = 0
+    evicted_unfired: int = 0
     step_times_s: List[float] = field(default_factory=list)
     host_times_s: List[float] = field(default_factory=list)
+    # wall-clock batch-arrival -> emission-dispatch latency, sampled on
+    # every step that emitted at least one record
+    emit_latencies_s: List[float] = field(default_factory=list)
+
+    def overflow_counts(self) -> dict:
+        """The loss counters a strict job must keep at zero."""
+        return {
+            "alert_overflow": self.alert_overflow,
+            "exchange_overflow": self.exchange_overflow,
+            "buffer_overflow": self.buffer_overflow,
+            "evicted_unfired": self.evicted_unfired,
+        }
 
     def summary(self) -> dict:
         total_step = sum(self.step_times_s)
+        lat = sorted(self.emit_latencies_s)
         return {
             "batches": self.batches,
             "records_in": self.records_in,
             "records_emitted": self.records_emitted,
             "window_fires": self.window_fires,
             "late_dropped": self.late_dropped,
+            "alert_overflow": self.alert_overflow,
+            "exchange_overflow": self.exchange_overflow,
+            "buffer_overflow": self.buffer_overflow,
+            "evicted_unfired": self.evicted_unfired,
             "device_time_s": total_step,
             "host_time_s": sum(self.host_times_s),
             "events_per_sec_device": (
                 self.records_in / total_step if total_step > 0 else None
             ),
+            "emit_latency_p50_ms": _percentile(lat, 0.50) * 1000.0,
+            "emit_latency_p99_ms": _percentile(lat, 0.99) * 1000.0,
         }
 
 

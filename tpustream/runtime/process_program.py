@@ -70,6 +70,7 @@ class ProcessWindowProgram(WindowProgram):
             "max_ts": jnp.asarray(W0, dtype=jnp.int64),
             "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
             "buffer_overflow": jnp.zeros((), dtype=jnp.int64),
+            "late_dropped": jnp.zeros((), dtype=jnp.int64),
         }
 
     def _step(self, state, cols, valid, ts, wm_lower):
@@ -177,6 +178,8 @@ class ProcessWindowProgram(WindowProgram):
             "max_ts": new_max,
             "evicted_unfired": state["evicted_unfired"] + evicted,
             "buffer_overflow": state["buffer_overflow"] + overflow,
+            "late_dropped": state["late_dropped"]
+            + jnp.sum(late).astype(jnp.int64),
         }
         emissions = {
             "process_fire": {
@@ -204,10 +207,14 @@ class ProcessWindowProgram(WindowProgram):
 
     def evaluate_fires(self, state, fire_info, post_ops, emit):
         """Host callback: gather fired windows' elements, run the user
-        ProcessWindowFunction, apply post ops, emit results."""
+        ProcessWindowFunction, apply post ops, emit results.
+
+        Returns ``(emitted, fired)`` — post-filter emissions vs raw
+        (key, window) fire invocations, for metrics parity with the
+        device-side ``window_fires`` counter."""
         fire = np.asarray(fire_info["fire"])
         if not fire.any():
-            return 0
+            return 0, 0
         win_cnt = np.asarray(fire_info["win_cnt"])
         ends = np.asarray(fire_info["ends"])
         cand = np.asarray(fire_info["cand"])
@@ -221,6 +228,7 @@ class ProcessWindowProgram(WindowProgram):
         key_table = tables[self.key_pos]
         n_shards = max(1, self.cfg.parallelism)
         emitted = 0
+        fired = 0
 
         for j in np.nonzero(fire)[0]:
             live_keys = np.nonzero(win_cnt[:, j] > 0)[0]
@@ -245,6 +253,7 @@ class ProcessWindowProgram(WindowProgram):
                     else int(key_id)
                 )
                 ctx = WindowContext(int(ends[j]) - ring.size_ms, int(ends[j]), wm)
+                fired += 1
                 out = Collector()
                 self.process_fn(key_val, ctx, elements, out)
                 for item in out.items:
@@ -257,4 +266,4 @@ class ProcessWindowProgram(WindowProgram):
                     if keep:
                         emit(item, int(key_id) % n_shards)
                         emitted += 1
-        return emitted
+        return emitted, fired

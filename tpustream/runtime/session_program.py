@@ -88,6 +88,8 @@ class SessionWindowProgram(WindowProgram):
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
             "cell_min": jnp.full((k, n), TS_MAX, dtype=jnp.int64),
             "cell_max": jnp.full((k, n), W0, dtype=jnp.int64),
+            "window_fires": jnp.zeros((), dtype=jnp.int64),
+            "late_dropped": jnp.zeros((), dtype=jnp.int64),
         }
 
     def state_specs(self, state):
@@ -181,7 +183,9 @@ class SessionWindowProgram(WindowProgram):
                 jnp.arange(n, dtype=jnp.int64) - (hi + 1), n
             ).astype(jnp.int32)
             clear_mask = cleared[:, inv]
-            return valid, out, overflow, clear_mask
+            # one fire per (key, session) with content, pre post-filter
+            n_fired = jnp.sum(emit_mask).astype(jnp.int64)
+            return valid, out, overflow, clear_mask, n_fired
 
         def no_fire(_):
             v = lambda x: pane_ops.vary(x, self.vary_axes)
@@ -198,6 +202,7 @@ class SessionWindowProgram(WindowProgram):
                 ],
                 v(jnp.zeros((), dtype=jnp.int64)),
                 v(jnp.zeros((k, n), dtype=bool)),
+                v(jnp.zeros((), dtype=jnp.int64)),
             )
 
         return jax.lax.cond(any_fire, do_fire, no_fire, operand=None)
@@ -252,7 +257,7 @@ class SessionWindowProgram(WindowProgram):
             keys, mid_cols, live, pane, ts,
         )
 
-        emit_valid, emit_cols, overflow, clear = self._fire_sessions(
+        emit_valid, emit_cols, overflow, clear, n_fired = self._fire_sessions(
             acc, cnt, cmin, cmax, slot_pane, hi, wm_new
         )
         cnt = jnp.where(clear, 0, cnt)
@@ -281,6 +286,9 @@ class SessionWindowProgram(WindowProgram):
                 "exchange_overflow", jnp.zeros((), dtype=jnp.int64)
             )
             + self._global_sum(xovf),
+            "window_fires": state["window_fires"] + self._global_sum(n_fired),
+            "late_dropped": state["late_dropped"]
+            + self._global_sum(jnp.sum(late).astype(jnp.int64)),
         }
         emissions = {
             "main": {

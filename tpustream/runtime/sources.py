@@ -47,11 +47,12 @@ class AdvanceProcessingTime:
 
 
 class Source:
+    # NOTE: there is deliberately no boundedness flag — every source ends
+    # by yielding a ``final`` batch (socket close, iterator exhaustion, or
+    # replay end), and the executor then emits the Flink end-of-source
+    # MAX watermark / final processing-time tick uniformly.
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         raise NotImplementedError  # pragma: no cover
-
-    def is_bounded(self) -> bool:
-        return False
 
 
 class ReplaySource(Source):
@@ -59,9 +60,6 @@ class ReplaySource(Source):
         self.items = list(items)
         self.start_ms = start_ms
         self.ms_per_record = ms_per_record
-
-    def is_bounded(self) -> bool:
-        return True
 
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         now = self.start_ms
@@ -90,12 +88,8 @@ class ReplaySource(Source):
 class IterableSource(Source):
     """Wraps any (possibly infinite) iterator of lines; wall-clock stamped."""
 
-    def __init__(self, it: Iterable, bounded: bool = True):
+    def __init__(self, it: Iterable):
         self._it = iter(it)
-        self._bounded = bounded
-
-    def is_bounded(self) -> bool:
-        return self._bounded
 
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         lines: List[str] = []

@@ -353,6 +353,8 @@ class WindowProgram(BaseProgram):
             "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
             "alert_overflow": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
+            "window_fires": jnp.zeros((), dtype=jnp.int64),
+            "late_dropped": jnp.zeros((), dtype=jnp.int64),
         }
 
     # ------------------------------------------------------------------
@@ -568,12 +570,14 @@ class WindowProgram(BaseProgram):
         zero_ovf = v(jnp.zeros((), dtype=jnp.int64))
         key_col = self._emission_keys()
 
+        zero_fires = v(jnp.zeros((), dtype=jnp.int64))
+
         def do_fire(_):
             def cand_body(carry, jj):
-                out_cols, count, ovf = carry
+                out_cols, count, ovf, fires = carry
 
                 def fire_one(c2):
-                    out_cols, count, ovf = c2
+                    out_cols, count, ovf, fires = c2
                     e_pane = cand[jj]
 
                     def pane_body(c3, o):
@@ -641,27 +645,36 @@ class WindowProgram(BaseProgram):
                     out_cols, new_count, overflowed = pane_ops.append_compact(
                         emit, src_cols, out_cols, count, cap
                     )
-                    return out_cols, new_count, ovf + overflowed
+                    # every (key, window) with content is one window fire,
+                    # counted BEFORE the post-chain filter (metrics parity
+                    # with Flink's per-trigger accounting)
+                    return (
+                        out_cols,
+                        new_count,
+                        ovf + overflowed,
+                        fires + jnp.sum(has).astype(jnp.int64),
+                    )
 
                 return jax.lax.cond(
-                    fire_now[jj], fire_one, lambda c2: c2, (out_cols, count, ovf)
+                    fire_now[jj], fire_one, lambda c2: c2,
+                    (out_cols, count, ovf, fires),
                 ), None
 
-            (out_cols, count, ovf), _ = jax.lax.scan(
+            (out_cols, count, ovf, fires), _ = jax.lax.scan(
                 cand_body,
-                (list(zero_out), zero_cnt, zero_ovf),
+                (list(zero_out), zero_cnt, zero_ovf, zero_fires),
                 jnp.arange(f),
             )
-            return out_cols, count, ovf
+            return out_cols, count, ovf, fires
 
         def no_fire(_):
-            return list(zero_out), zero_cnt, zero_ovf
+            return list(zero_out), zero_cnt, zero_ovf, zero_fires
 
-        out_cols, count, overflow = jax.lax.cond(
+        out_cols, count, overflow, n_fired = jax.lax.cond(
             any_fire, do_fire, no_fire, operand=None
         )
         emit_valid = jnp.arange(cap, dtype=jnp.int32) < count
-        return emit_valid, out_cols, overflow, new_ft, n_deferred
+        return emit_valid, out_cols, overflow, new_ft, n_deferred, n_fired
 
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
@@ -725,9 +738,11 @@ class WindowProgram(BaseProgram):
             planes, cnt, keys, mid_cols, live, pane
         )
 
-        emit_valid, emit_cols, overflow, new_ft, n_pending = self._fire_dense(
-            planes, cnt, slot_pane, hi, wm_old, wm_new,
-            state["fired_through"], touched,
+        emit_valid, emit_cols, overflow, new_ft, n_pending, n_fired = (
+            self._fire_dense(
+                planes, cnt, slot_pane, hi, wm_old, wm_new,
+                state["fired_through"], touched,
+            )
         )
 
         n_shards = max(1, self.cfg.parallelism)
@@ -752,6 +767,11 @@ class WindowProgram(BaseProgram):
                 "exchange_overflow", jnp.zeros((), dtype=jnp.int64)
             )
             + self._global_sum(xovf),
+            "window_fires": state["window_fires"] + self._global_sum(n_fired),
+            # counted on-device so the job observes its drops even without
+            # a late side output configured
+            "late_dropped": state["late_dropped"]
+            + self._global_sum(jnp.sum(late).astype(jnp.int64)),
         }
         emissions = {
             "main": {
