@@ -1,0 +1,124 @@
+"""Tumbling count windows (VERDICT round-1 item 5: implement the
+count_window API that previously had no program).
+
+Flink ``countWindow(N)`` semantics pinned here: fires per key every N
+elements in arrival order, partial windows never fire (not even at end
+of stream), and results are identical at any batch size / parallelism.
+"""
+
+import pytest
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.api.tuples import Tuple2
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+
+def parse(line):
+    p = line.split(" ")
+    return Tuple2(p[0], float(p[1]))
+
+
+def run_reduce(lines, n, **cfg):
+    cfg.setdefault("batch_size", 4)
+    cfg.setdefault("key_capacity", 16)
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(lines))
+    handle = (
+        text.map(parse)
+        .key_by(0)
+        .count_window(n)
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    env.execute("count-reduce")
+    return [(t.f0, t.f1) for t in handle.items], env.metrics.summary()
+
+
+LINES = [
+    "a 1", "a 2", "b 10", "a 4",      # a window closes: 1+2+4 = 7
+    "b 20", "a 8", "b 30",            # b window closes: 10+20+30 = 60
+    "a 16", "a 32",                   # a closes again: 8+16+32 = 56
+    "a 64", "b 40",                   # partials: never fire
+]
+
+
+def test_count_window_reduce_fires_every_n():
+    rows, s = run_reduce(LINES, 3)
+    assert ("a", 7.0) in rows
+    assert ("a", 56.0) in rows
+    assert ("b", 60.0) in rows
+    assert len(rows) == 3              # partials (a:64, b:40) never fire
+    assert s["window_fires"] == 3
+
+
+def test_count_window_batch_invariance():
+    expect, _ = run_reduce(LINES, 3)
+    for bs in (1, 2, 11):
+        rows, _ = run_reduce(LINES, 3, batch_size=bs)
+        assert sorted(rows) == sorted(expect)
+
+
+def test_count_window_many_closes_per_batch_per_key():
+    # one key, 9 elements in a single batch, N=2 -> 4 closes in one step
+    lines = [f"k {2 ** i}" for i in range(9)]
+    rows, s = run_reduce(lines, 2, batch_size=16)
+    assert rows == [("k", 3.0), ("k", 12.0), ("k", 48.0), ("k", 192.0)]
+    assert s["window_fires"] == 4
+
+
+def test_count_window_aggregate():
+    from tpustream import AggregateFunction
+
+    class Avg(AggregateFunction):
+        def create_accumulator(self):
+            return Tuple2(0, 0.0)
+
+        def add(self, value, acc):
+            acc.f0 = acc.f0 + 1
+            acc.f1 = acc.f1 + value.f1
+            return acc
+
+        def get_result(self, acc):
+            import jax.numpy as jnp
+
+            return jnp.where(acc.f0 == 0, 0.0, acc.f1 / acc.f0)
+
+        def merge(self, a, b):
+            a.f0 = a.f0 + b.f0
+            a.f1 = a.f1 + b.f1
+            return a
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=3, key_capacity=16)
+    )
+    text = env.add_source(ReplaySource(["a 1", "a 3", "b 5", "a 10", "a 20"]))
+    handle = (
+        text.map(parse).key_by(0).count_window(2).aggregate(Avg()).collect()
+    )
+    env.execute("count-agg")
+    assert handle.items == [2.0, 15.0]
+
+
+def test_count_window_sharded_matches_single_chip():
+    single, s1 = run_reduce(LINES, 3, parallelism=1)
+    sharded, s8 = run_reduce(
+        LINES, 3, parallelism=8, batch_size=16, key_capacity=64,
+        print_parallelism=1,
+    )
+    assert sorted(sharded) == sorted(single)
+    assert s8["window_fires"] == s1["window_fires"] == 3
+
+
+def test_count_window_process_rejected():
+    env = StreamExecutionEnvironment(StreamConfig(key_capacity=16))
+    text = env.add_source(ReplaySource(["a 1"]))
+    (
+        text.map(parse)
+        .key_by(0)
+        .count_window(2)
+        .process(lambda key, ctx, elements, out: out.collect(0.0))
+        .collect()
+    )
+    with pytest.raises(NotImplementedError, match="count_window"):
+        env.execute("count-process")

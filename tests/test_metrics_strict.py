@@ -125,6 +125,37 @@ def test_process_buffer_overflow_strict_raises():
         env.execute("overflow-strict")
 
 
+def test_late_to_side_output_not_counted_as_dropped():
+    # Flink's numLateRecordsDropped counts only records NOT consumed by a
+    # side output; delivered-late records are not drops
+    from tpustream.api.output import OutputTag
+
+    lines = [
+        f"{BASE + 10} www.a.com 100",
+        f"{BASE + 70} www.a.com 7",
+        f"{BASE + 20} www.a.com 900",  # late -> side output, NOT dropped
+    ]
+    tag = OutputTag("late")
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=1, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines))
+    w = (
+        text.assign_timestamps_and_watermarks(SecondsExtractor())
+        .map(parse)
+        .key_by(1)
+        .window(TumblingEventTimeWindows.of(Time.seconds(60)))
+        .side_output_late_data(tag)
+    )
+    summed = w.reduce(lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2))
+    summed.collect()
+    late = summed.get_side_output(tag).collect()
+    env.execute("late-side")
+    assert len(late.items) == 1
+    assert env.metrics.summary()["late_dropped"] == 0
+
+
 def run_sharded_reduce(lines, **cfg_overrides):
     env = StreamExecutionEnvironment(
         StreamConfig(

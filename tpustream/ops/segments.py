@@ -83,6 +83,17 @@ def segment_tails(seg_starts: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([seg_starts[1:], jnp.ones((1,), dtype=bool)])
 
 
+def segment_ranks(seg_starts: jnp.ndarray) -> jnp.ndarray:
+    """0-based rank of each row within its segment (int32), via a cummax
+    of the segment-start positions."""
+    n = seg_starts.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    seg_first = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(seg_starts, pos, 0)
+    )
+    return pos - seg_first
+
+
 def inverse_permutation(perm: jnp.ndarray) -> jnp.ndarray:
     # int32 positions: batch sizes fit easily, and an int64-valued
     # scatter would hit v5e's emulated 64-bit scatter cliff (~7x slower,

@@ -1,0 +1,157 @@
+"""Tumbling count windows: fire every N elements per key.
+
+Flink's ``countWindow(size)`` (mentioned alongside the reference's window
+taxonomy, chapter3/README.md:35-41) buffers per-key elements and fires
+when the count reaches ``size``; partial windows never fire, not even at
+end of stream. TPU-native design: no element buffers at all — the
+incremental reduce/aggregate accumulator folds in batch order via the
+same sort + segmented-scan kernel the rolling aggregates use, with
+window boundaries expressed as extra segment starts wherever a key's
+running element index crosses a multiple of N. A batch may open and
+close many windows for one key in a single step; every close emits, all
+in one compiled XLA program.
+
+Sharding follows the rolling program: keyBy exchange routes records to
+the key-owner shard, per-key (acc, cnt) state shards over the mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.segments import (
+    inverse_permutation,
+    segment_ranks,
+    segment_tails,
+    segmented_scan,
+    sort_by_key,
+)
+from .device import DeviceChain
+from .plan import JobPlan
+from .step import BaseProgram
+from .window_program import WindowProgram
+
+
+class CountWindowProgram(WindowProgram):
+    """Borrows WindowProgram's aggregation plumbing (_build_agg: lift /
+    combine / finalize over leaf tuples) but none of its time machinery —
+    count windows have no watermark, no pane ring, and no lateness."""
+
+    accepted_kinds = ("count",)
+
+    def __init__(self, plan: JobPlan, cfg):
+        BaseProgram.__init__(self, plan, cfg)
+        st = plan.stateful
+        spec = st.window
+        self.key_pos = plan.key_pos
+        self.apply_kind = st.apply_kind
+        if self.apply_kind == "process":
+            raise NotImplementedError(
+                "count_window supports reduce/aggregate; use a time window "
+                "for full-window process() functions"
+            )
+        self.count_n = int(spec.count)
+        if self.count_n < 1:
+            raise ValueError(f"count_window size must be >= 1, got {spec.count}")
+        self.n_shards = 1
+        self.local_key_capacity = cfg.key_capacity
+        self._build_agg()
+        self.post_chain = DeviceChain(
+            plan.device_post, self.result_kinds, self.result_tables
+        )
+        self.out_kinds = self.post_chain.out_kinds
+        self.out_tables = self.post_chain.out_tables
+
+    def init_state(self):
+        k = self.cfg.key_capacity
+        return {
+            # typed per-key accumulator leaves + open-window element count
+            "acc": [
+                jnp.zeros((k,), dtype=self._acc_dtype(kd))
+                for kd in self.acc_kinds
+            ],
+            "cnt": jnp.zeros((k,), dtype=jnp.int32),
+            "window_fires": jnp.zeros((), dtype=jnp.int64),
+            "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
+        }
+
+    def state_specs(self, state):
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import AXIS
+
+        # per-key [K] leaves shard on the key axis, scalars replicate
+        return jax.tree_util.tree_map(
+            lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
+        )
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+        K = state["cnt"].shape[0]
+        N = self.count_n
+
+        perm, sk, sv, seg_starts = sort_by_key(keys, mask, max_key=K)
+        sorted_cols = [c[perm] for c in mid_cols]
+        lifted = list(self.lift(tuple(sorted_cols)))
+
+        b = sv.shape[0]
+        rank = segment_ranks(seg_starts)
+        safe_sk = jnp.where(sv, sk, 0).astype(jnp.int32)
+        prev = state["cnt"][safe_sk]          # open-window count, 0..N-1
+        tot = prev + rank                     # element's window position
+
+        # a window OPENS wherever the position crosses a multiple of N:
+        # restart the scan there so each (key, window) is its own segment
+        win_start = jnp.mod(tot, N) == 0
+        scan = segmented_scan(
+            tuple(lifted), seg_starts | win_start, self.combine
+        )
+        # the key's first window this batch continues the stored partial
+        stored = tuple(a[safe_sk] for a in state["acc"])
+        folded_all = self.combine(stored, scan)
+        fold = (tot < N) & (prev > 0) & sv
+        folded = tuple(
+            jnp.where(fold, f, s) for f, s in zip(folded_all, scan)
+        )
+
+        closes = (jnp.mod(tot + 1, N) == 0) & sv
+        results = self.finalize(folded)
+        post_cols, post_mask = self.post_chain.apply(list(results), closes)
+
+        # per-key tail writes back the (possibly reset) accumulator; a
+        # tail that exactly closed its window leaves cnt == 0, which marks
+        # the stale acc value as empty
+        tails = segment_tails(seg_starts) & sv
+        idx = jnp.where(tails, sk, K).astype(jnp.int32)
+        new_acc = [
+            a.at[idx].set(f.astype(a.dtype), mode="drop", unique_indices=True)
+            for a, f in zip(state["acc"], folded)
+        ]
+        new_cnt = state["cnt"].at[idx].set(
+            jnp.mod(tot + 1, N), mode="drop", unique_indices=True
+        )
+
+        inv = inverse_permutation(perm)
+        n_shards = max(1, self.cfg.parallelism)
+        subtask = self._global_key_ids(safe_sk) % n_shards
+        new_state = {
+            "acc": new_acc,
+            "cnt": new_cnt,
+            "window_fires": state["window_fires"]
+            + self._global_sum(jnp.sum(closes).astype(jnp.int64)),
+            "exchange_overflow": state["exchange_overflow"]
+            + self._global_sum(xovf),
+        }
+        return new_state, {
+            "main": {
+                "mask": post_mask,
+                "cols": tuple(post_cols),
+                "subtask": subtask,
+                # emissions stay in sorted order; host un-permutes
+                "order": self._row_offset(b) + inv.astype(jnp.int32),
+            }
+        }

@@ -185,6 +185,26 @@ class Runner:
         )
         self.in_kinds = plan.record_kinds
         self._empty_cache = None
+        # device counter values restored from a checkpoint (finalize
+        # subtracts them so a resumed run reports since-resume numbers
+        # and strict_overflow never fails on pre-snapshot loss)
+        self._counter_baseline: Dict[str, int] = {}
+
+    _COUNTER_NAMES = (
+        "window_fires", "late_dropped", "alert_overflow",
+        "exchange_overflow", "buffer_overflow", "evicted_unfired",
+    )
+
+    def snapshot_counter_baseline(self):
+        if not isinstance(self.state, dict):
+            return
+        present = {
+            n: self.state[n] for n in self._COUNTER_NAMES if n in self.state
+        }
+        if present:
+            self._counter_baseline = {
+                n: int(v) for n, v in jax.device_get(present).items()
+            }
 
     def _check_capacity(self):
         if self.plan.key_pos is None:
@@ -245,10 +265,13 @@ class Runner:
         Window programs fire at most ``max_fires_per_step`` window ends
         per step (bounding fire-step latency); the loop here drains any
         deferred ends until ``state["pending_fires"]`` reaches zero."""
-        if self.plan.stateful is None or self.plan.stateful.kind in (
-            "rolling",
-            "rolling_reduce",
+        st = self.plan.stateful
+        if st is None or st.kind in ("rolling", "rolling_reduce") or (
+            st.window is not None and st.window.kind == "count"
         ):
+            # rolling aggregates emit per record and count windows fire
+            # per element count: neither has time semantics, so a clock
+            # tick / EOS flush can never produce output
             return
         if t_batch is None:
             t_batch = time.perf_counter()
@@ -285,18 +308,17 @@ class Runner:
         scalar fetch per job, never on the per-batch hot path)."""
         if not isinstance(self.state, dict):
             return
-        names = (
-            "window_fires", "late_dropped", "alert_overflow",
-            "exchange_overflow", "buffer_overflow", "evicted_unfired",
-        )
-        present = {n: self.state[n] for n in names if n in self.state}
+        present = {
+            n: self.state[n] for n in self._COUNTER_NAMES if n in self.state
+        }
         if not present:
             return
         vals = jax.device_get(present)
         for n, val in vals.items():
             # window_fires for the host-evaluated process path is counted
             # host-side; device programs count on device — += merges both
-            setattr(self.metrics, n, getattr(self.metrics, n) + int(val))
+            delta = int(val) - self._counter_baseline.get(n, 0)
+            setattr(self.metrics, n, getattr(self.metrics, n) + delta)
 
     def check_strict(self):
         """strict_overflow: fail loudly if any lossy counter is nonzero
@@ -425,6 +447,7 @@ def execute_job(env, sink_nodes) -> JobResult:
         ck.restore_tables(plan)
         runner = Runner(plan, cfg, metrics)
         runner.state = ck.restore_state(runner.program)
+        runner.snapshot_counter_baseline()
         skip_lines = ck.source_pos
         proc_now = ck.proc_now
     lines_consumed = skip_lines

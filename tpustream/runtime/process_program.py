@@ -28,7 +28,7 @@ from ..records import BOOL, F64, I64, NUMPY_DTYPES, STR
 from ..api.timeapi import TimeCharacteristic
 from ..ops import panes as pane_ops
 from ..ops.panes import W0
-from ..ops.segments import sort_by_key
+from ..ops.segments import segment_ranks, sort_by_key
 from ..api.tuples import make_tuple
 from .device import DeviceChain
 from .plan import JobPlan
@@ -111,12 +111,7 @@ class ProcessWindowProgram(WindowProgram):
         slot = jnp.mod(pane, n)
         cell = keys.astype(jnp.int64) * n + slot
         perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=k * n)
-        b = keys.shape[0]
-        pos = jnp.arange(b, dtype=jnp.int64)
-        seg_first = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(seg_starts, pos, 0)
-        )
-        rank = pos - seg_first
+        rank = segment_ranks(seg_starts)
         cell_sorted = jnp.clip(sc, 0, k * n - 1)
         base = cnt.reshape(-1)[cell_sorted]
         write_pos = base.astype(jnp.int64) + rank
@@ -134,7 +129,7 @@ class ProcessWindowProgram(WindowProgram):
         from ..ops.segments import segment_tails as _segtails
 
         tails = _segtails(seg_starts) & sv
-        seg_count = (pos - seg_first + 1).astype(jnp.int32)
+        seg_count = rank + 1
         cnt = (
             cnt.reshape(-1)
             .at[jnp.where(tails, jnp.clip(sc, 0, k * n - 1), k * n)]
@@ -179,7 +174,11 @@ class ProcessWindowProgram(WindowProgram):
             "evicted_unfired": state["evicted_unfired"] + evicted,
             "buffer_overflow": state["buffer_overflow"] + overflow,
             "late_dropped": state["late_dropped"]
-            + jnp.sum(late).astype(jnp.int64),
+            + (
+                jnp.sum(late).astype(jnp.int64)
+                if self.count_late_as_dropped
+                else 0
+            ),
         }
         emissions = {
             "process_fire": {

@@ -288,7 +288,11 @@ class SessionWindowProgram(WindowProgram):
             + self._global_sum(xovf),
             "window_fires": state["window_fires"] + self._global_sum(n_fired),
             "late_dropped": state["late_dropped"]
-            + self._global_sum(jnp.sum(late).astype(jnp.int64)),
+            + (
+                self._global_sum(jnp.sum(late).astype(jnp.int64))
+                if self.count_late_as_dropped
+                else 0
+            ),
         }
         emissions = {
             "main": {

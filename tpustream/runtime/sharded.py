@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.exchange import exchange_by_key
 from ..parallel.mesh import AXIS, make_mesh
+from .count_program import CountWindowProgram
 from .plan import JobPlan
 from .session_program import SessionWindowProgram
 from .step import RollingProgram
@@ -119,6 +120,15 @@ class ShardedSessionWindowProgram(_ShardedMixin, SessionWindowProgram):
 
 
 class ShardedRollingProgram(_ShardedMixin, RollingProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit()
+
+
+class ShardedCountWindowProgram(_ShardedMixin, CountWindowProgram):
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         self._setup_sharding(cfg)

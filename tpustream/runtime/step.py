@@ -209,6 +209,14 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
             return ShardedRollingProgram(plan, cfg)
         return RollingProgram(plan, cfg)
     if plan.stateful.kind == "window":
+        if plan.stateful.window is not None and plan.stateful.window.kind == "count":
+            if sharded:
+                from .sharded import ShardedCountWindowProgram
+
+                return ShardedCountWindowProgram(plan, cfg)
+            from .count_program import CountWindowProgram
+
+            return CountWindowProgram(plan, cfg)
         if plan.stateful.window is not None and plan.stateful.window.kind == "session":
             if sharded:
                 from .sharded import ShardedSessionWindowProgram

@@ -88,6 +88,10 @@ class WindowProgram(BaseProgram):
                 "chapter3/.../BandwidthMonitorWithEventTime.java:29)"
             )
         self.allowed_lateness_ms = st.allowed_lateness_ms
+        # Flink's numLateRecordsDropped counts only records NOT consumed
+        # by a late side output; with a side output configured the
+        # records are delivered, not dropped
+        self.count_late_as_dropped = not plan.side_outputs
         self.domain = spec.time_domain
         if self.domain == TimeCharacteristic.EventTime:
             # ingestion time rides the event machinery with delay 0
@@ -769,9 +773,14 @@ class WindowProgram(BaseProgram):
             + self._global_sum(xovf),
             "window_fires": state["window_fires"] + self._global_sum(n_fired),
             # counted on-device so the job observes its drops even without
-            # a late side output configured
+            # a late side output configured (0 when one is: delivered late
+            # records are not drops)
             "late_dropped": state["late_dropped"]
-            + self._global_sum(jnp.sum(late).astype(jnp.int64)),
+            + (
+                self._global_sum(jnp.sum(late).astype(jnp.int64))
+                if self.count_late_as_dropped
+                else 0
+            ),
         }
         emissions = {
             "main": {
