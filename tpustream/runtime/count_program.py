@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..ops.segments import (
     inverse_permutation,
@@ -30,7 +29,7 @@ from ..ops.segments import (
 )
 from .device import DeviceChain
 from .plan import JobPlan
-from .step import BaseProgram
+from .step import BaseProgram, RollingProgram
 from .window_program import WindowProgram
 
 
@@ -40,6 +39,7 @@ class CountWindowProgram(WindowProgram):
     count windows have no watermark, no pane ring, and no lateness."""
 
     accepted_kinds = ("count",)
+    fires_on_clock = False
 
     def __init__(self, plan: JobPlan, cfg):
         BaseProgram.__init__(self, plan, cfg)
@@ -77,15 +77,9 @@ class CountWindowProgram(WindowProgram):
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
         }
 
-    def state_specs(self, state):
-        from jax.sharding import PartitionSpec as P
-
-        from ..parallel.mesh import AXIS
-
-        # per-key [K] leaves shard on the key axis, scalars replicate
-        return jax.tree_util.tree_map(
-            lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
-        )
+    # per-key [K] leaves shard on the key axis, scalars replicate — the
+    # same rule the rolling per-key state uses
+    state_specs = RollingProgram.state_specs
 
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)

@@ -265,13 +265,7 @@ class Runner:
         Window programs fire at most ``max_fires_per_step`` window ends
         per step (bounding fire-step latency); the loop here drains any
         deferred ends until ``state["pending_fires"]`` reaches zero."""
-        st = self.plan.stateful
-        if st is None or st.kind in ("rolling", "rolling_reduce") or (
-            st.window is not None and st.window.kind == "count"
-        ):
-            # rolling aggregates emit per record and count windows fire
-            # per element count: neither has time semantics, so a clock
-            # tick / EOS flush can never produce output
+        if not self.program.fires_on_clock:
             return
         if t_batch is None:
             t_batch = time.perf_counter()

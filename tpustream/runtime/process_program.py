@@ -70,28 +70,39 @@ class ProcessWindowProgram(WindowProgram):
             "max_ts": jnp.asarray(W0, dtype=jnp.int64),
             "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
             "buffer_overflow": jnp.zeros((), dtype=jnp.int64),
+            "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
             "late_dropped": jnp.zeros((), dtype=jnp.int64),
         }
 
+    def state_specs(self, state):
+        # the base ndim>=2 rule is exactly right here: buf [K,N,cap] and
+        # cnt [K,N] shard on the key axis, ring metadata/scalars replicate
+        # (WindowProgram's override is for its flat word-plane layout)
+        return BaseProgram.state_specs(self, state)
+
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
-        keys = mid_cols[self.key_pos].astype(jnp.int32)
         ring = self.ring
-        k, n = self.cfg.key_capacity, ring.n_slots
+        n = ring.n_slots
         cap = self.cfg.process_buffer_capacity
 
         wm_old = state["wm"]
-        batch_max = jnp.max(jnp.where(mask, ts, W0))
+        batch_max = self._global_max(jnp.max(jnp.where(mask, ts, W0)))
         new_max = jnp.maximum(state["max_ts"], batch_max)
         wm_new = jnp.maximum(
             wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
         )
 
+        # keyBy: route records to their key-owner shard (ICI all_to_all)
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+        k = state["cnt"].shape[0]  # LOCAL key rows under shard_map
+
         late = pane_ops.late_mask(ts, wm_old, self.allowed_lateness_ms, ring) & mask
         live = mask & ~late
 
         pane = pane_ops.pane_of(ts, ring.pane_ms)
-        batch_hi = jnp.max(jnp.where(live, pane, -1))
+        batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
         # ---- retarget ring (clear stale slots incl. buffers) -------------
@@ -151,7 +162,11 @@ class ProcessWindowProgram(WindowProgram):
             member = (slot_pane[:, None] <= cand[None, :]) & (
                 slot_pane[:, None] > (cand[None, :] - ring.panes_per_window)
             )
-            dirty = (touched.astype(jnp.int32) @ member.astype(jnp.int32)) > 0
+            # refires must be shard-agreed: any shard's dirty pane marks
+            # the candidate dirty everywhere so `fire` stays replicated
+            dirty = self._global_max(
+                touched.astype(jnp.int32) @ member.astype(jnp.int32)
+            ) > 0
             aligned = jnp.mod(ends, ring.slide_ms) == 0
             fire = fire | (
                 aligned
@@ -171,11 +186,15 @@ class ProcessWindowProgram(WindowProgram):
             "hi": hi,
             "wm": wm_new,
             "max_ts": new_max,
-            "evicted_unfired": state["evicted_unfired"] + evicted,
-            "buffer_overflow": state["buffer_overflow"] + overflow,
+            "evicted_unfired": state["evicted_unfired"]
+            + self._global_sum(evicted),
+            "buffer_overflow": state["buffer_overflow"]
+            + self._global_sum(overflow),
+            "exchange_overflow": state["exchange_overflow"]
+            + self._global_sum(xovf),
             "late_dropped": state["late_dropped"]
             + (
-                jnp.sum(late).astype(jnp.int64)
+                self._global_sum(jnp.sum(late).astype(jnp.int64))
                 if self.count_late_as_dropped
                 else 0
             ),
@@ -186,7 +205,9 @@ class ProcessWindowProgram(WindowProgram):
                 "ends": ends,
                 "cand": cand,
                 "win_cnt": win_cnt,
-                "wm": wm_new,
+                # singleton (not scalar) so the sharded out_spec can stack
+                # one replicated copy per shard
+                "wm": wm_new[None],
             },
             "late": {"mask": late, "cols": tuple(mid_cols)},
         }
@@ -210,37 +231,45 @@ class ProcessWindowProgram(WindowProgram):
 
         Returns ``(emitted, fired)`` — post-filter emissions vs raw
         (key, window) fire invocations, for metrics parity with the
-        device-side ``window_fires`` counter."""
-        fire = np.asarray(fire_info["fire"])
+        device-side ``window_fires`` counter.
+
+        Sharded layout: state/emission leaves assemble with shard-major
+        key rows (row = shard * local_keys + local_row holds global key
+        ``local_row * n_shards + shard``), and replicated per-candidate
+        leaves arrive stacked once per shard — slice the first copy."""
+        ring = self.ring
+        F = ring.n_fire_candidates
+        S = max(1, self.n_shards)
+        fire = np.asarray(fire_info["fire"]).reshape(-1)[:F]
         if not fire.any():
             return 0, 0
         win_cnt = np.asarray(fire_info["win_cnt"])
-        ends = np.asarray(fire_info["ends"])
-        cand = np.asarray(fire_info["cand"])
-        wm = int(np.asarray(fire_info["wm"]))
+        ends = np.asarray(fire_info["ends"]).reshape(-1)[:F]
+        cand = np.asarray(fire_info["cand"]).reshape(-1)[:F]
+        wm = int(np.asarray(fire_info["wm"]).reshape(-1)[0])
         cnt = np.asarray(state["cnt"])
         slot_pane = np.asarray(state["slot_pane"])
         bufs = [np.asarray(b) for b in state["buf"]]
-        ring = self.ring
         n, cap = ring.n_slots, self.cfg.process_buffer_capacity
         kinds, tables = self.mid_kinds, self.mid_tables
         key_table = tables[self.key_pos]
-        n_shards = max(1, self.cfg.parallelism)
+        k_local = self.local_key_capacity
         emitted = 0
         fired = 0
 
         for j in np.nonzero(fire)[0]:
             live_keys = np.nonzero(win_cnt[:, j] > 0)[0]
-            for key_id in live_keys:
+            for key_row in live_keys:
+                key_id = int(key_row % k_local) * S + int(key_row // k_local)
                 elements = []
                 for q in range(int(cand[j]) - ring.panes_per_window + 1, int(cand[j]) + 1):
                     s = q % n
-                    if slot_pane[s] != q or cnt[key_id, s] == 0:
+                    if slot_pane[s] != q or cnt[key_row, s] == 0:
                         continue
-                    stored = min(int(cnt[key_id, s]), cap)
+                    stored = min(int(cnt[key_row, s]), cap)
                     for r in range(stored):
                         vals = [
-                            self._value(kd, tb, b[key_id, s, r])
+                            self._value(kd, tb, b[key_row, s, r])
                             for kd, tb, b in zip(kinds, tables, bufs)
                         ]
                         elements.append(
@@ -263,6 +292,6 @@ class ProcessWindowProgram(WindowProgram):
                         else:
                             keep = keep and bool(as_callable(fn, "filter")(item))
                     if keep:
-                        emit(item, int(key_id) % n_shards)
+                        emit(item, key_id % S)
                         emitted += 1
         return emitted, fired

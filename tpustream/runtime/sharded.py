@@ -23,6 +23,7 @@ from ..parallel.exchange import exchange_by_key
 from ..parallel.mesh import AXIS, make_mesh
 from .count_program import CountWindowProgram
 from .plan import JobPlan
+from .process_program import ProcessWindowProgram
 from .session_program import SessionWindowProgram
 from .step import RollingProgram
 from .window_program import WindowProgram
@@ -129,6 +130,20 @@ class ShardedRollingProgram(_ShardedMixin, RollingProgram):
 
 
 class ShardedCountWindowProgram(_ShardedMixin, CountWindowProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit()
+
+
+class ShardedProcessWindowProgram(_ShardedMixin, ProcessWindowProgram):
+    """Full-window process() at parallelism N: the keyBy exchange routes
+    records to their owner shard, element buffers shard on the key axis,
+    and the host callback sees global key ids
+    (reference chapter2/README.md:177-196 runs at parallelism N too)."""
+
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         self._setup_sharding(cfg)

@@ -67,6 +67,11 @@ class BaseProgram:
             lambda leaf: P(AXIS) if leaf.ndim >= 2 else P(), state
         )
 
+    # False for programs with no time semantics (per-record rolling,
+    # count windows, stateless chains): a clock tick / EOS flush step can
+    # never produce output for them, so the executor skips it
+    fires_on_clock = True
+
     # -- SPMD hooks: identity on one chip, mesh collectives when sharded --
     n_shards = 1
     vary_axes: tuple = ()
@@ -98,6 +103,8 @@ class BaseProgram:
 class StatelessProgram(BaseProgram):
     """map/filter-only pipeline (reference chapter1 job, SURVEY.md §3.1)."""
 
+    fires_on_clock = False
+
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         super().__init__(plan, cfg)
         self.out_kinds = self.mid_kinds
@@ -116,6 +123,8 @@ class StatelessProgram(BaseProgram):
 class RollingProgram(BaseProgram):
     """keyBy + rolling aggregate, emitting per record
     (reference chapter2/.../ComputeCpuMax.java:26)."""
+
+    fires_on_clock = False
 
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         super().__init__(plan, cfg)
@@ -227,11 +236,9 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
             return SessionWindowProgram(plan, cfg)
         if plan.stateful.apply_kind == "process":
             if sharded:
-                raise NotImplementedError(
-                    "ProcessWindowFunction (host-evaluated full-window path) "
-                    "currently runs single-shard; use reduce/aggregate for "
-                    "sharded jobs"
-                )
+                from .sharded import ShardedProcessWindowProgram
+
+                return ShardedProcessWindowProgram(plan, cfg)
             from .process_program import ProcessWindowProgram
 
             return ProcessWindowProgram(plan, cfg)
