@@ -149,3 +149,137 @@ def test_event_time_window_resume_fast_path(tmp_path):
         acc_dtype="int32",
         max_fires_per_step=1,
     )
+
+
+# ---------------------------------------------------------------------------
+# VERDICT round-1 item 8: checkpoint/resume onto an 8-device mesh and for
+# the session/process/count programs
+# ---------------------------------------------------------------------------
+def sharded_cfg(parallelism=8):
+    return dict(
+        parallelism=parallelism,
+        batch_size=16,
+        key_capacity=64,
+        print_parallelism=1,
+    )
+
+
+def test_sharded_eventtime_resume(tmp_path):
+    """ch3 sliding windows at parallelism=8: every snapshot resumes onto
+    the fresh mesh sharding and emits exactly the remaining suffix."""
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+
+    items = [
+        f"2019-08-28T10:{m:02d}:{s:02d} www.ch{(m * 3 + s) % 5}.com {100 + m * 10}"
+        for m in range(6)
+        for s in (0, 30)
+    ]
+    resume_suffix_check(
+        build, items, tmp_path,
+        time_char=TimeCharacteristic.EventTime, **sharded_cfg(),
+    )
+
+
+def test_sharded_rolling_resume(tmp_path):
+    from tpustream.jobs.chapter2_max import build
+
+    lines = [
+        f"15634520{i:02d} 10.8.22.{i % 5} cpu0 {50 + (i * 31) % 47}.5"
+        for i in range(24)
+    ]
+    resume_suffix_check(build, lines, tmp_path, **sharded_cfg())
+
+
+def test_process_median_resume(tmp_path):
+    """Full-window process() buffers (elements, counts, ring) checkpoint
+    and resume mid-window, single-chip and at parallelism=4."""
+    from tpustream.jobs.chapter2_median import build
+
+    items = (
+        [
+            f"15634520{i:02d} 10.8.22.{i % 3} cpu0 {10 + (i * 7) % 50}.5"
+            for i in range(10)
+        ]
+        + [AdvanceProcessingTime(61_000)]
+        + [f"15634521{i:02d} 10.8.22.{i % 3} cpu0 {90 + i}.0" for i in range(4)]
+        + [AdvanceProcessingTime(122_000)]
+    )
+    resume_suffix_check(build, items, tmp_path / "solo")
+    resume_suffix_check(
+        build, items, tmp_path / "p4",
+        parallelism=4, batch_size=4, key_capacity=64, print_parallelism=1,
+    )
+
+
+def test_session_window_resume(tmp_path):
+    """Session cells (acc, min/max boundary timestamps) survive a
+    mid-session snapshot: the merged session still fires once."""
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        Time,
+        Tuple2,
+    )
+    from tpustream.api.windows import EventTimeSessionWindows
+
+    class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(2_000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    def build(env, text):
+        return (
+            text.assign_timestamps_and_watermarks(TsExtractor())
+            .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .window(EventTimeSessionWindows.with_gap(Time.milliseconds(10_000)))
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        )
+
+    lines = [
+        "1000 a 1", "4000 a 2", "5000 b 16", "9000 a 4",
+        "25000 a 8",   # closes a's first session (1+2+4) and b's (16)
+        "27000 b 32",
+        "45000 a 64",  # closes the 25000/27000 sessions
+    ]
+    full = resume_suffix_check(
+        build, lines, tmp_path, time_char=TimeCharacteristic.EventTime,
+        key_capacity=64, alert_capacity=1024,
+    )
+    assert sorted((t.f0, t.f1) for t in full) == [
+        ("a", 7), ("a", 8), ("a", 64), ("b", 16), ("b", 32),
+    ]
+
+
+def test_count_window_resume(tmp_path):
+    """Per-key (acc, cnt) count-window state resumes mid-window."""
+    from tpustream import Tuple2
+
+    def build(env, text):
+        return (
+            text.map(lambda l: Tuple2(l.split(" ")[0], float(l.split(" ")[1])))
+            .key_by(0)
+            .count_window(3)
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        )
+
+    lines = ["a 1", "a 2", "b 10", "a 4", "b 20", "a 8", "b 30", "a 16", "a 32"]
+    full = resume_suffix_check(build, lines, tmp_path, key_capacity=64)
+    assert [(t.f0, t.f1) for t in full] == [("a", 7.0), ("b", 60.0), ("a", 56.0)]
+
+
+def test_restore_rejects_parallelism_mismatch(tmp_path):
+    """Sharded keyed state is laid out shard-major: restoring under a
+    different parallelism must fail loudly, not silently mis-key."""
+    from tpustream.jobs.chapter2_max import build
+
+    lines = [f"15634520{i:02d} 10.8.22.{i % 5} cpu0 {50 + i}.0" for i in range(16)]
+    ckdir = tmp_path / "ck"
+    run_job(build, lines, tmpdir=ckdir, **sharded_cfg())
+    snap = checkpoints(ckdir)[-1]
+    with pytest.raises(ValueError, match="parallelism"):
+        run_job(
+            build, lines, restore=snap,
+            parallelism=4, batch_size=16, key_capacity=64, print_parallelism=1,
+        )

@@ -33,7 +33,9 @@ import numpy as np
 
 # v2: window/process/session state gained device-side metric counter
 # leaves (window_fires / late_dropped), changing the snapshot treedef
-FORMAT_VERSION = 2
+# v3: process state gained exchange_overflow (sharded process windows);
+# meta records parallelism because the sharded key layout is shard-major
+FORMAT_VERSION = 3
 _META_KEY = "__meta__"
 
 
@@ -53,6 +55,7 @@ class Checkpoint:
     emitted: int                     # records emitted before this snapshot
     batches: int
     job_name: Optional[str] = None
+    parallelism: int = 1             # mesh shards at snapshot time
 
     def restore_state(self, program):
         """Re-place the saved leaves onto ``program``'s init-state shardings.
@@ -62,6 +65,17 @@ class Checkpoint:
         job-graph mismatch surfaces as a structure/shape error here rather
         than as silent corruption later.
         """
+        # the sharded key layout is shard-major (row shard*k_local+r holds
+        # global key r*S+shard), so global shapes match across parallelism
+        # values while the layout does not — refuse the silent corruption
+        prog_par = max(1, getattr(program, "n_shards", 1))
+        if self.parallelism != prog_par:
+            raise ValueError(
+                f"checkpoint was written at parallelism={self.parallelism} "
+                f"but the job runs at parallelism={prog_par} — keyed state "
+                "rows are laid out shard-major and cannot be re-mapped; "
+                "resume with the original parallelism"
+            )
         target = program.init_state()
         t_leaves, treedef = jax.tree_util.tree_flatten(target)
         if len(t_leaves) != len(self.leaves):
@@ -70,15 +84,30 @@ class Checkpoint:
                 f"program expects {len(t_leaves)} — job graph or config "
                 "changed since the snapshot"
             )
+        # mesh programs: place each leaf onto its state_specs sharding
+        # (key-axis leaves split over shards, scalars replicate) so the
+        # restored pytree enters the shard_map step exactly like a fresh
+        # one; committing to a single device instead would conflict with
+        # the mesh at dispatch
+        mesh = getattr(program, "mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            spec_leaves = jax.tree_util.tree_leaves(
+                program.state_specs(target),
+                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+            )
+            shardings = [NamedSharding(mesh, s) for s in spec_leaves]
+        else:
+            shardings = [None] * len(t_leaves)
         placed = []
-        for saved, like in zip(self.leaves, t_leaves):
+        for saved, like, sharding in zip(self.leaves, t_leaves, shardings):
             if tuple(saved.shape) != tuple(like.shape) or saved.dtype != like.dtype:
                 raise ValueError(
                     f"checkpoint leaf {saved.shape}/{saved.dtype} does not "
                     f"match program state {like.shape}/{like.dtype} — "
                     "key_capacity / batch_size / window config changed"
                 )
-            sharding = getattr(like, "sharding", None)
             placed.append(
                 jax.device_put(saved, sharding) if sharding is not None else saved
             )
@@ -114,6 +143,7 @@ def save_checkpoint(
     emitted: int,
     batches: int,
     job_name: Optional[str] = None,
+    parallelism: int = 1,
     keep: int = 3,
 ) -> str:
     """Snapshot to ``directory/ckpt-<batches>.npz`` (atomic rename); prunes
@@ -130,6 +160,7 @@ def save_checkpoint(
         "emitted": int(emitted),
         "batches": int(batches),
         "job_name": job_name,
+        "parallelism": int(parallelism),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
     name = f"ckpt-{batches:010d}.npz"
@@ -200,4 +231,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         emitted=meta["emitted"],
         batches=meta["batches"],
         job_name=meta.get("job_name"),
+        parallelism=meta.get("parallelism", 1),
     )

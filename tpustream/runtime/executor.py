@@ -498,6 +498,7 @@ def execute_job(env, sink_nodes) -> JobResult:
                 emitted=metrics.records_emitted,
                 batches=metrics.batches,
                 job_name=env.job_name,
+                parallelism=max(1, cfg.parallelism),
             )
         if sb.final:
             break
