@@ -215,6 +215,68 @@ def test_rolling_matches_reference_across_batches(kind):
             )
 
 
+@pytest.mark.parametrize("kind", ["max", "min", "sum"])
+@pytest.mark.parametrize("key_col", [None, 0])
+@pytest.mark.parametrize("pos", [1, 2])  # i64 (two-plane) and f64 agg leaves
+@pytest.mark.parametrize("compact_mode", ["none", "agg"])
+def test_rolling_commutative_fast_path_matches_oracle(
+    kind, key_col, pos, compact_mode
+):
+    """The max/min/sum fast path (single-column scan, key-column
+    reconstruction, cond-deferred new-key bookkeeping) must match the
+    record-at-a-time oracle batch by batch — including batches with no
+    new keys at all, which exercise the steady-state cond branch.
+    ``pos=1`` aggregates an i64 leaf, covering the two-word-plane
+    lo/hi pack-and-scatter of the aggregated column; ``compact_mode
+    "agg"`` covers its single-plane 32-bit layout."""
+    rng = np.random.default_rng(7)
+    kinds = ["str", "i64", "f64", "bool"]
+    kcap, b = 13, 96
+    compact = (
+        False if compact_mode == "none" else [i == pos for i in range(4)]
+    )
+    combine = make_combiner(kind, pos)
+    state = init_rolling_state(kcap, kinds, compact)
+
+    batches = []
+    for it in range(5):
+        # confine early batches to few keys so later batches are all-seen
+        hi = kcap if it < 2 else 4
+        keys = rng.integers(0, hi, b).astype(np.int32)
+        c0 = keys.copy()
+        c1 = rng.integers(-50, 50, b).astype(np.int64)
+        c2 = np.round(rng.random(b) * 100, 1).astype(np.float64)
+        c3 = rng.random(b) < 0.5
+        valid = rng.random(b) < 0.9
+        batches.append((keys, (c0, c1, c2, c3), valid))
+
+    want = _rolling_reference(kind, pos, batches, 4)
+    kw = {}
+    if key_col is not None:
+        kw = dict(key_col=0, key_emit=lambda s: s.astype(jnp.int32))
+    for (keys, cols, valid), w in zip(batches, want):
+        state, emis_sorted, sv, sk, inv = rolling_step(
+            state,
+            jnp.asarray(keys),
+            tuple(jnp.asarray(c) for c in cols),
+            jnp.asarray(valid),
+            combine,
+            kinds,
+            compact,
+            rolling_kind=kind,
+            rolling_pos=pos,
+            **kw,
+        )
+        inv = np.asarray(inv)
+        for c in range(4):
+            arrival = np.asarray(emis_sorted[c])[inv]
+            np.testing.assert_allclose(
+                arrival[valid].astype(np.float64),
+                w[c][valid].astype(np.float64),
+                rtol=1e-5,
+            )
+
+
 # ------------------------------------------------------------- sessions ----
 
 def test_session_runs_link_and_fire_propagation():

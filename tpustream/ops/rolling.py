@@ -28,7 +28,14 @@ from .segments import (
     segmented_scan,
     sort_by_key,
 )
-from .wordplanes import pack_words, plane_dtypes, unpack_words
+from .segments import _bcast
+from .wordplanes import (
+    _per_leaf,
+    leaf_plane_slices,
+    pack_words,
+    plane_dtypes,
+    unpack_words,
+)
 
 
 def init_rolling_state(
@@ -91,6 +98,10 @@ def rolling_step(
     combine: Callable,
     kinds: List[str],
     compact32: Union[bool, Sequence[bool]] = False,
+    rolling_kind: str = None,
+    rolling_pos: int = None,
+    key_col: int = None,
+    key_emit: Callable = None,
 ) -> Tuple[dict, Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batch through a rolling aggregate.
 
@@ -101,7 +112,22 @@ def rolling_step(
     aggregates the keyed column itself (e.g. keyBy(p).sum(p)). The device does NOT un-permute the emissions: the inverse
     gathers cost more than the whole state update on v5e (measured), so
     the host applies ``inv`` with a numpy gather off the critical path.
+
+    When ``rolling_kind``/``rolling_pos`` name a commutative field
+    aggregate (max/min/sum — Flink's keep-first semantics for every
+    other field), the step takes a fast path that scans only the
+    aggregated column, reconstructs the key column from the sorted keys
+    (``key_col``/``key_emit``, skipping its state plane entirely), and
+    defers all new-key bookkeeping behind a ``lax.cond`` that is skipped
+    once the key space is warm — on v5e this roughly halves step cost at
+    1M keys (the general path pays one ~2.6 ms 32-bit plane scatter per
+    record field per batch).
     """
+    if rolling_kind in ("max", "min", "sum"):
+        return _rolling_step_commutative(
+            state, keys, cols, valid, kinds, compact32,
+            rolling_kind, rolling_pos, key_col, key_emit,
+        )
     K = state["seen"].shape[0]
     perm, sk, sv, seg_starts = sort_by_key(keys, valid, max_key=K)
     sorted_cols = tuple(c[perm] for c in cols)
@@ -132,3 +158,127 @@ def rolling_step(
 
     inv = inverse_permutation(perm)
     return {"seen": new_seen, "planes": new_planes}, emis_sorted, sv, sk, inv
+
+
+_REDUCERS = {
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+    "sum": lambda a, b: a + b,
+}
+
+
+def _rolling_step_commutative(
+    state, keys, cols, valid, kinds, compact32, kind, pos, key_col, key_emit
+):
+    """Fast path for max/min/sum field aggregates (see rolling_step)."""
+    K = state["seen"].shape[0]
+    reducer = _REDUCERS[kind]
+    slices = leaf_plane_slices(kinds, compact32)
+    c32 = _per_leaf(compact32, kinds)
+    if key_col is not None and (key_emit is None or key_col == pos):
+        key_col = None  # aggregating the keyed column: not key-invariant
+
+    perm, sk, sv, seg_starts = sort_by_key(keys, valid, max_key=K)
+    safe_keys = jnp.where(sv, sk, 0).astype(jnp.int32)
+    tails = segment_tails(seg_starts) & sv
+    tail_idx = jnp.where(tails, sk, K).astype(jnp.int32)
+
+    n_planes = len(state["planes"])
+
+    def gather_leaf(i):
+        words = [
+            state["planes"][p][safe_keys]
+            for p in range(*slices[i].indices(n_planes))
+        ]
+        return unpack_words(words, [kinds[i]], [c32[i]])[0]
+
+    # aggregated column: within-batch inclusive per-key prefix
+    agg_sorted = cols[pos][perm]
+    (agg_prefix,) = segmented_scan(
+        (agg_sorted,), seg_starts, lambda a, b: (reducer(a[0], b[0]),)
+    )
+    seen_sorted = state["seen"][safe_keys] & sv
+    stored_agg = gather_leaf(pos)
+    combined_agg = reducer(stored_agg, agg_prefix)
+    emis_agg = jnp.where(seen_sorted, combined_agg, agg_prefix)
+
+    # per-batch state value for the aggregated leaf IS its tail emission
+    new_planes = list(state["planes"])
+    agg_words = pack_words([emis_agg], [kinds[pos]], [c32[pos]])
+    for p, w in zip(range(*slices[pos].indices(n_planes)), agg_words):
+        new_planes[p] = state["planes"][p].at[tail_idx].set(
+            w.astype(state["planes"][p].dtype), mode="drop", unique_indices=True
+        )
+
+    keep = [i for i in range(len(kinds)) if i != pos and i != key_col]
+    stored_keep = [gather_leaf(i) for i in keep]
+    any_new = jnp.any(sv & ~seen_sorted)
+
+    # keep-first leaves + seen only change when the batch contains a key
+    # never seen before; the warm steady state takes the cond's false
+    # branch, skipping their plane scatters and the segment-first
+    # broadcast (the stored_keep gathers above still run every batch —
+    # seen-key emissions need them)
+    keep_plane_ids = [
+        p for i in keep for p in range(*slices[i].indices(len(new_planes)))
+    ]
+
+    def with_new(keep_planes, seen):
+        n = sk.shape[0]
+        posr = jnp.arange(n, dtype=jnp.int32)
+        seg_first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(seg_starts, posr, 0)
+        )
+        new_idx = jnp.where(tails & ~seen_sorted, sk, K).astype(jnp.int32)
+        out_emis, out_planes = [], list(keep_planes)
+        flat = 0
+        for j, i in enumerate(keep):
+            first_i = cols[i][perm][seg_first]
+            emis_i = jnp.where(
+                _bcast(seen_sorted, first_i), stored_keep[j], first_i
+            )
+            out_emis.append(emis_i)
+            for w in pack_words([emis_i], [kinds[i]], [c32[i]]):
+                p = out_planes[flat]
+                out_planes[flat] = p.at[new_idx].set(
+                    w.astype(p.dtype), mode="drop", unique_indices=True
+                )
+                flat += 1
+        new_seen = seen.at[new_idx].set(True, mode="drop", unique_indices=True)
+        return tuple(out_emis), tuple(out_planes), new_seen
+
+    def no_new(keep_planes, seen):
+        return tuple(stored_keep), tuple(keep_planes), seen
+
+    keep_emis, keep_planes_out, new_seen = jax.lax.cond(
+        any_new,
+        with_new,
+        no_new,
+        tuple(state["planes"][p] for p in keep_plane_ids),
+        state["seen"],
+    )
+    for flat, p in enumerate(keep_plane_ids):
+        new_planes[p] = keep_planes_out[flat]
+
+    # assemble sorted-order emissions in leaf order
+    emis_sorted = []
+    kj = 0
+    for i in range(len(kinds)):
+        if i == pos:
+            emis_sorted.append(emis_agg)
+        elif i == key_col:
+            emis_sorted.append(key_emit(sk))
+        else:
+            emis_sorted.append(keep_emis[kj])
+            kj += 1
+
+    inv = inverse_permutation(perm)
+    return (
+        {"seen": new_seen, "planes": new_planes},
+        tuple(emis_sorted),
+        sv,
+        sk,
+        inv,
+    )
+
+

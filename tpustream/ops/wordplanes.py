@@ -63,6 +63,21 @@ def plane_dtypes(
     return out
 
 
+def leaf_plane_slices(
+    kinds: Sequence[str], compact32: Union[bool, Sequence[bool]] = False
+) -> List[slice]:
+    """Per-leaf slice into the flat plane list (i64 non-compact owns two
+    planes, everything else one) — lets kernels touch only the planes of
+    the leaves they actually update."""
+    out: List[slice] = []
+    start = 0
+    for k, c32 in zip(kinds, _per_leaf(compact32, kinds)):
+        n = 2 if (k == I64 and not c32) else 1
+        out.append(slice(start, start + n))
+        start += n
+    return out
+
+
 def pack_words(
     cols: Sequence[jnp.ndarray],
     kinds: Sequence[str],

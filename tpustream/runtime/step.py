@@ -183,9 +183,24 @@ class RollingProgram(BaseProgram):
         mid_cols, mask, ts, _ = self._exchange(mid_cols, mask, ts)
         gkeys = mid_cols[self.key_pos]
         keys = self._local_keys(gkeys)
+        st = self.plan.stateful
+        fast_kwargs = {}
+        if st.kind == "rolling":
+            fast_kwargs = dict(
+                rolling_kind=st.rolling_kind, rolling_pos=st.rolling_pos
+            )
+            key_kind = self.mid_kinds[self.key_pos]
+            if self.key_pos != st.rolling_pos and key_kind in (STR, I64):
+                # key column is key-invariant: emit it straight from the
+                # sorted key ids and never touch its state plane
+                dt = jnp.int32 if key_kind == STR else jnp.int64
+                fast_kwargs["key_col"] = self.key_pos
+                fast_kwargs["key_emit"] = (
+                    lambda sks: self._global_key_ids(sks).astype(dt)
+                )
         new_state, emitted_sorted, sv, sk, inv = rolling_ops.rolling_step(
             state, keys, tuple(mid_cols), mask, self.combine,
-            self.mid_kinds, self._compact32,
+            self.mid_kinds, self._compact32, **fast_kwargs,
         )
         # emissions stay in sorted order; the host un-permutes via
         # emissions["order"] (device-side inverse gathers dominate the
