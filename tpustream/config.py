@@ -72,6 +72,18 @@ class StreamConfig:
     # median), alert_overflow, or evicted_unfired. Default False keeps
     # the counters observable in JobResult.summary() without failing.
 
+    # -- host<->device pipeline --------------------------------------------
+    async_depth: int = 2
+    # Steps allowed in flight before the executor fetches a step's
+    # emissions: 1 = fully synchronous (fetch right after enqueue);
+    # 2 (default) = double-buffered — batch N+1 is parsed and enqueued
+    # while N's emissions cross PCIe, so host, transfer, and device
+    # compute overlap (SURVEY.md §7 "double-buffered async dispatch").
+    # Sink output order is unchanged; only its wall-clock moment shifts.
+    # Programs whose emissions are evaluated against live device state
+    # (full-window process()) force depth 1. Raise past 2 when the
+    # link's round-trip latency exceeds a step's device time.
+
     # -- misc ---------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_batches: int = 0  # 0 = disabled

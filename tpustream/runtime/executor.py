@@ -42,8 +42,65 @@ class HostStage:
         self.cfg = cfg
         self._ts_eval: Optional[PlanEvaluator] = None
         self._map_evals: Dict[int, PlanEvaluator] = {}
+        self._raw_eval = None       # combined [ts?]+outputs native parser
+        self._raw_eval_built = False
+        self._raw_has_ts = False
         if plan.ts_expr is not None:
             self._ts_eval = PlanEvaluator([plan.ts_expr], [None])
+
+    def _build_raw_eval(self):
+        """One native parse pass computing the event-time column (when
+        assigned) AND the parse map's output columns straight from a raw
+        byte buffer — the ingest path that never touches per-line Python
+        objects. None when the job's host stage can't take it (fallback
+        map, raw-stage filter/flat_map, punctuated watermarks)."""
+        plan = self.plan
+        if len(plan.host_ops) != 1:
+            return None
+        hop = plan.host_ops[0]
+        if hop.op != "map" or hop.plan is None or hop.plan.fallback_fn is not None:
+            return None
+        if plan.ts_assigner is not None and plan.ts_expr is None:
+            return None
+        if isinstance(plan.ts_assigner, AssignerWithPunctuatedWatermarks):
+            return None
+        exprs, tbls = [], []
+        self._raw_has_ts = plan.ts_expr is not None
+        if self._raw_has_ts:
+            exprs.append(plan.ts_expr)
+            tbls.append(None)
+        exprs.extend(hop.plan.outputs)
+        tbls.extend(
+            t if k == STR else None
+            for k, t in zip(plan.record_kinds, plan.tables)
+        )
+        ev = PlanEvaluator(exprs, tbls)
+        return ev if ev._native is not None else None
+
+    def process_raw(self, raw: bytes, n: int, proc_ts: np.ndarray):
+        """Raw-buffer twin of :meth:`process`. Returns (Batch, wm_hint)
+        or (None, None) when the native lane can't parse this batch —
+        the caller then decodes and takes the line path."""
+        if not n:
+            return None, None
+        if not self._raw_eval_built:
+            self._raw_eval = self._build_raw_eval()
+            self._raw_eval_built = True
+        if self._raw_eval is None:
+            return None, None
+        cols = self._raw_eval.parse_bytes(raw, n)
+        if cols is None:
+            return None, None
+        ts = None
+        if self._raw_has_ts:
+            ts = np.asarray(cols[0], dtype=np.int64)
+            cols = cols[1:]
+        plan = self.plan
+        columns = [
+            Column(k, c, t)
+            for k, c, t in zip(plan.record_kinds, cols, plan.tables)
+        ]
+        return Batch(n, columns, ts=ts, proc_ts=proc_ts), None
 
     def _timestamps(self, lines: List[str]) -> Optional[np.ndarray]:
         plan = self.plan
@@ -177,7 +234,7 @@ class Runner:
         self.cfg = cfg
         self.metrics = metrics
         self.program = build_program(plan, cfg)
-        self.step = self.program.jitted_step()
+        self.step = self._counted_step(self.program.jitted_step())
         self.state = self.program.init_state()
         self.sinks, self.side_sinks = _make_sinks(plan, cfg)
         self.formatter = EmissionFormatter(
@@ -185,6 +242,14 @@ class Runner:
         )
         self.in_kinds = plan.record_kinds
         self._empty_cache = None
+        # emission pipelining: up to (async_depth - 1) steps stay in
+        # flight before their emissions are fetched, overlapping host
+        # parse + H2D of the next batch with device compute and D2H of
+        # the previous one. Programs that evaluate emissions against
+        # live device state (full-window process()) must stay sync.
+        depth = 1 if self.program.emissions_reference_state else cfg.async_depth
+        self._max_inflight = max(0, depth - 1)
+        self._inflight: List[tuple] = []
         # device counter values restored from a checkpoint (finalize
         # subtracts them so a resumed run reports since-resume numbers
         # and strict_overflow never fails on pre-snapshot loss)
@@ -287,15 +352,58 @@ class Runner:
         self._run_step(cols, valid, ts, wm_lower, t_batch)
         self._drain(wm_lower, t_batch)
 
+    def _counted_step(self, inner):
+        """Wrap the program's jitted step to also return one scalar
+        count per emission stream, so the host can skip fetching the
+        batch-sized emission buffers of a step that emitted nothing —
+        on a step with no alerts the only D2H traffic is these scalars."""
+
+        def step(state, cols, valid, ts, wm_lower):
+            state, em = inner(state, cols, valid, ts, wm_lower)
+            counts = {}
+            for name, stream in em.items():
+                if "mask" in stream:
+                    counts[name] = stream["mask"].sum(dtype=jnp.int32)
+                elif "fire" in stream:
+                    counts[name] = stream["fire"].sum(dtype=jnp.int32)
+            return state, em, counts
+
+        return jax.jit(step, donate_argnums=0)
+
     def _run_step(self, cols, valid, ts, wm_lower: int, t_batch=None):
         """One jitted step + emission dispatch (the only step call site)."""
         with Stopwatch() as sw:
-            self.state, emissions = self.step(
+            self.state, emissions, counts = self.step(
                 self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
             )
-            emissions = jax.device_get(emissions)
+            for leaf in counts.values():
+                leaf.copy_to_host_async()
         self.metrics.step_times_s.append(sw.elapsed)
-        self._dispatch(emissions, t_batch)
+        self._inflight.append((emissions, counts, t_batch))
+        while len(self._inflight) > self._max_inflight:
+            self._finish(*self._inflight.pop(0))
+
+    def drain_inflight(self):
+        """Dispatch every pending step's emissions (checkpoint barrier /
+        end of stream)."""
+        while self._inflight:
+            self._finish(*self._inflight.pop(0))
+
+    def _finish(self, emissions, counts, t_batch):
+        # the blocking waits live here, not in _run_step (dispatch is
+        # async) — time them into step_times_s so summary()'s
+        # device_time_s still reflects device + transfer occupancy
+        with Stopwatch() as sw:
+            cnts = jax.device_get(counts)
+            fetch = {
+                name: stream
+                for name, stream in emissions.items()
+                if cnts.get(name, 1)
+                and (name != "late" or self.side_sinks)
+            }
+            fetched = jax.device_get(fetch) if fetch else {}
+        self.metrics.step_times_s.append(sw.elapsed)
+        self._dispatch(fetched, t_batch)
 
     def finalize_metrics(self):
         """Fold the device-side cumulative counters into Metrics (one
@@ -456,16 +564,44 @@ def execute_job(env, sink_nodes) -> JobResult:
         return LONG_MIN + 1
 
     for sb in plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms):
-        if skip_lines > 0 and sb.lines:
+        if skip_lines > 0 and sb.n_records:
             # resume: drop source lines the checkpointed run already consumed
-            take = min(skip_lines, len(sb.lines))
-            sb = SourceBatch(
-                sb.lines[take:], sb.proc_ts[take:], sb.advance_proc_to, sb.final
-            )
+            take = min(skip_lines, sb.n_records)
+            if sb.raw is not None:
+                if take == sb.n_raw:
+                    rest = b""
+                else:
+                    off = 0
+                    for _ in range(take):
+                        off = sb.raw.index(b"\n", off) + 1
+                    rest = sb.raw[off:]
+                sb = SourceBatch(
+                    [], sb.proc_ts[take:], sb.advance_proc_to, sb.final,
+                    raw=rest, n_raw=sb.n_raw - take,
+                )
+            else:
+                sb = SourceBatch(
+                    sb.lines[take:], sb.proc_ts[take:], sb.advance_proc_to,
+                    sb.final,
+                )
             skip_lines -= take
-        lines_consumed += len(sb.lines)
+        lines_consumed += sb.n_records
         with Stopwatch() as hw:
-            batch, wm_hint = host.process(sb.lines, sb.proc_ts)
+            if sb.raw is not None:
+                batch, wm_hint = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
+                if batch is None and sb.n_raw:
+                    # native lane unavailable: decode and take the line path
+                    lines = sb.raw.decode("utf-8", "replace").split("\n")
+                    if len(lines) == sb.n_raw + 1 and lines[-1] == "":
+                        lines.pop()  # trailing newline
+                    if len(lines) != sb.n_raw:
+                        raise ValueError(
+                            f"raw source batch declares {sb.n_raw} lines "
+                            f"but contains {len(lines)}"
+                        )
+                    batch, wm_hint = host.process(lines, sb.proc_ts)
+            else:
+                batch, wm_hint = host.process(sb.lines, sb.proc_ts)
         metrics.host_times_s.append(hw.elapsed)
         metrics.batches += 1
         if sb.proc_ts.size:
@@ -489,6 +625,10 @@ def execute_job(env, sink_nodes) -> JobResult:
         ):
             from .checkpoint import save_checkpoint
 
+            # emissions still in flight belong to pre-snapshot batches;
+            # a resume replays only post-snapshot lines, so flush them
+            # to the sinks before the state is captured
+            runner.drain_inflight()
             save_checkpoint(
                 cfg.checkpoint_dir,
                 state=runner.state,
@@ -513,6 +653,7 @@ def execute_job(env, sink_nodes) -> JobResult:
             runner.flush(MAX_WATERMARK)
 
     if runner is not None:
+        runner.drain_inflight()
         runner.finalize_metrics()
         runner.check_strict()
 

@@ -40,6 +40,10 @@ class ProcessWindowProgram(WindowProgram):
     """Shares the watermark/ring/late machinery of WindowProgram but stores
     raw elements and defers evaluation to a host callback."""
 
+    # evaluate_fires gathers fired elements from the CURRENT state
+    # buffers, so emissions cannot outlive the step that produced them
+    emissions_reference_state = True
+
     def _build_agg(self) -> None:
         # no incremental aggregation: accumulators ARE the element buffers
         self.acc_kinds = list(self.mid_kinds)

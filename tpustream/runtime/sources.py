@@ -27,12 +27,25 @@ import numpy as np
 
 @dataclass
 class SourceBatch:
-    """One host-side pull from a source."""
+    """One host-side pull from a source.
+
+    Either ``lines`` (decoded Python strings) or ``raw`` (a
+    newline-separated byte buffer of ``n_raw`` lines, never both) —
+    the raw form feeds the native columnar parser without ever
+    materializing per-line Python objects, which is what lets the host
+    side keep up with the device at millions of events/sec on one core.
+    """
 
     lines: List[str]
     proc_ts: np.ndarray                 # int64 epoch ms per line
     advance_proc_to: Optional[int] = None  # force the proc-time clock forward
     final: bool = False                 # end of stream
+    raw: Optional[bytes] = None         # newline-separated buffer
+    n_raw: int = 0                      # line count of ``raw``
+
+    @property
+    def n_records(self) -> int:
+        return self.n_raw if self.raw is not None else len(self.lines)
 
 
 @dataclass(frozen=True)
@@ -83,6 +96,42 @@ class ReplaySource(Source):
             if len(lines) >= batch_size:
                 yield flush()
         yield flush(final=True)
+
+
+class ReplayBytesSource(Source):
+    """Replays pre-rendered newline-separated byte buffers through the
+    raw ingest lane (native parse, no per-line Python objects).
+
+    ``buffers`` is a list of ``(raw_bytes, n_lines)`` pairs; the whole
+    list replays ``loop`` times. The virtual processing-time clock
+    advances ``ms_per_batch`` per buffer (0 = constant clock), mirroring
+    ReplaySource's deterministic stamping."""
+
+    def __init__(
+        self,
+        buffers: List[tuple],
+        start_ms: int = 0,
+        ms_per_batch: int = 0,
+        loop: int = 1,
+    ):
+        self.buffers = list(buffers)
+        self.start_ms = start_ms
+        self.ms_per_batch = ms_per_batch
+        self.loop = loop
+
+    def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
+        now = self.start_ms
+        for _ in range(self.loop):
+            for raw, n in self.buffers:
+                yield SourceBatch(
+                    [],
+                    np.full(n, now, dtype=np.int64),
+                    raw=raw,
+                    n_raw=n,
+                )
+                now += self.ms_per_batch
+        # final flush carries no clock advance, exactly like ReplaySource
+        yield SourceBatch([], np.empty(0, dtype=np.int64), final=True)
 
 
 class IterableSource(Source):

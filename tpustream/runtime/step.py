@@ -72,6 +72,12 @@ class BaseProgram:
     # never produce output for them, so the executor skips it
     fires_on_clock = True
 
+    # True for programs whose emission payload is gathered from live
+    # device state AFTER the step (full-window process()): the executor
+    # must dispatch them before enqueuing another step, so emission
+    # pipelining (StreamConfig.async_depth) is forced off
+    emissions_reference_state = False
+
     # -- SPMD hooks: identity on one chip, mesh collectives when sharded --
     n_shards = 1
     vary_axes: tuple = ()
