@@ -115,7 +115,10 @@ def test_raw_resume_skips_consumed_lines(tmp_path):
         StreamConfig(
             batch_size=8,
             checkpoint_dir=ckdir,
-            checkpoint_interval_batches=2,
+            # 5 data batches + 1 final empty batch: the ONLY checkpoint
+            # lands after batch 4 (32 lines) — neither the full stream
+            # nor a multiple of the resume chunking below
+            checkpoint_interval_batches=4,
         )
     )
     text = env.add_source(ReplayBytesSource(_to_buffers(lines, 8)))
@@ -123,12 +126,14 @@ def test_raw_resume_skips_consumed_lines(tmp_path):
     env.execute("ch1-ck")
     full = h1.items
 
+    # resume with DIFFERENT buffer chunking (12/buffer vs the 8/buffer
+    # checkpoint run): skipping 32 lines lands 8 lines INTO the third
+    # buffer, exercising the newline-scanning partial raw trim
     env2 = StreamExecutionEnvironment(StreamConfig(batch_size=8))
     env2.restore_from_checkpoint(ckdir)
-    text2 = env2.add_source(ReplayBytesSource(_to_buffers(lines, 8)))
+    text2 = env2.add_source(ReplayBytesSource(_to_buffers(lines, 12)))
     h2 = build_ch1(env2, text2).collect()
     env2.execute("ch1-resume")
-    # the checkpoint saved after batch 2*k; the resumed run replays the
-    # suffix only — together <= full, and the resumed part matches
+    # 8 lines remain past the checkpoint; all alert (usage > 90)
+    assert 0 < len(h2.items) < len(full)
     assert h2.items == full[len(full) - len(h2.items):]
-    assert len(h2.items) < len(full)

@@ -109,6 +109,17 @@ class ProcessWindowProgram(WindowProgram):
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
+        # coverage guard: when one batch spans more panes than the ring
+        # (a large event-time jump), records below the new coverage would
+        # alias mod-N into slots owned by other panes and corrupt their
+        # buffers. Drop + count them instead (evicted_unfired; the
+        # reduce/aggregate window path sweeps such jumps exactly —
+        # full-window buffers cannot, since fires are host-evaluated
+        # against post-step state).
+        uncov = live & (pane <= hi - ring.n_slots)
+        live = live & ~uncov
+        n_uncov = self._global_sum(jnp.sum(uncov).astype(jnp.int64))
+
         # ---- retarget ring (clear stale slots incl. buffers) -------------
         target = pane_ops.slot_targets(hi, ring)
         stale = state["slot_pane"] != target
@@ -191,7 +202,8 @@ class ProcessWindowProgram(WindowProgram):
             "wm": wm_new,
             "max_ts": new_max,
             "evicted_unfired": state["evicted_unfired"]
-            + self._global_sum(evicted),
+            + self._global_sum(evicted)
+            + n_uncov,
             "buffer_overflow": state["buffer_overflow"]
             + self._global_sum(overflow),
             "exchange_overflow": state["exchange_overflow"]

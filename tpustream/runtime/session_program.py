@@ -230,6 +230,13 @@ class SessionWindowProgram(WindowProgram):
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
+        # coverage guard (see ProcessWindowProgram._step): records below
+        # ring coverage after a jump would alias mod-N into live session
+        # cells — drop + count rather than corrupt
+        uncov = live & (pane <= hi - ring.n_slots)
+        live = live & ~uncov
+        n_uncov = self._global_sum(jnp.sum(uncov).astype(jnp.int64))
+
         init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
 
         def do_retarget(_):
@@ -279,7 +286,8 @@ class SessionWindowProgram(WindowProgram):
             "wm": wm_new,
             "max_ts": new_max,
             "evicted_unfired": state["evicted_unfired"]
-            + self._global_sum(evicted),
+            + self._global_sum(evicted)
+            + n_uncov,
             "alert_overflow": state["alert_overflow"]
             + self._global_sum(overflow),
             "exchange_overflow": state.get(

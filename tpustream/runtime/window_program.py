@@ -527,8 +527,17 @@ class WindowProgram(BaseProgram):
     # dense fire path
     # ------------------------------------------------------------------
     def _fire_dense(
-        self, planes, cnt, slot_pane, hi, wm_old, wm_new, fired_through, touched
+        self, planes, cnt, slot_pane, hi, wm_old, wm_new, fired_through, touched,
+        emission_carry=None, budget_on=None,
     ):
+        """Fire due window ends from the ring.
+
+        ``emission_carry`` (out_cols, count, ovf, fires) lets the jump
+        sweep (:meth:`_sweep`) append fires across iterations into one
+        emission buffer; None starts fresh. ``budget_on`` (traced bool)
+        suspends the max_fires_per_step budget on non-final sweep
+        iterations — a deferred fire there would fall out of ring
+        coverage before the next drain tick could reach it."""
         ring = self.ring
         k, n, f = self.local_key_capacity, ring.n_slots, ring.n_fire_candidates
         cap = self.cfg.alert_capacity
@@ -538,6 +547,8 @@ class WindowProgram(BaseProgram):
         aligned = jnp.mod(ends, ring.slide_ms) == 0
         pending = aligned & (ends - 1 <= wm_new) & (ends - 1 > fired_through)
         budget = self.cfg.max_fires_per_step or f
+        if budget_on is not None:
+            budget = jnp.where(budget_on, budget, f)
         csum = jnp.cumsum(pending.astype(jnp.int32))
         fire_now = pending & (csum <= budget)
         n_deferred = (jnp.sum(pending) - jnp.sum(fire_now)).astype(jnp.int64)
@@ -565,16 +576,11 @@ class WindowProgram(BaseProgram):
         )
         any_fire = jnp.any(fire_now)
 
-        out_dtypes = [
-            self._acc_dtype(kd) for kd in self.post_chain.out_kinds
-        ] + [np.int32, np.int64]  # + key, window_end
         v = lambda x: pane_ops.vary(x, self.vary_axes)
-        zero_out = [v(jnp.zeros((cap,), dtype=dt)) for dt in out_dtypes]
-        zero_cnt = v(jnp.zeros((), dtype=jnp.int32))
-        zero_ovf = v(jnp.zeros((), dtype=jnp.int64))
+        if emission_carry is None:
+            emission_carry = self._zero_emission_carry()
+        carry_out, carry_cnt, carry_ovf, carry_fires = emission_carry
         key_col = self._emission_keys()
-
-        zero_fires = v(jnp.zeros((), dtype=jnp.int64))
 
         def do_fire(_):
             def cand_body(carry, jj):
@@ -666,19 +672,134 @@ class WindowProgram(BaseProgram):
 
             (out_cols, count, ovf, fires), _ = jax.lax.scan(
                 cand_body,
-                (list(zero_out), zero_cnt, zero_ovf, zero_fires),
+                (list(carry_out), carry_cnt, carry_ovf, carry_fires),
                 jnp.arange(f),
             )
             return out_cols, count, ovf, fires
 
         def no_fire(_):
-            return list(zero_out), zero_cnt, zero_ovf, zero_fires
+            return list(carry_out), carry_cnt, carry_ovf, carry_fires
 
         out_cols, count, overflow, n_fired = jax.lax.cond(
             any_fire, do_fire, no_fire, operand=None
         )
-        emit_valid = jnp.arange(cap, dtype=jnp.int32) < count
-        return emit_valid, out_cols, overflow, new_ft, n_deferred, n_fired
+        # (cols, count, overflow, fires) is cumulative past the carry —
+        # re-feed it as emission_carry to append further sweep fires
+        return (out_cols, count, overflow, n_fired), new_ft, n_deferred
+
+    def _sweep(
+        self, planes, cnt, slot_pane, hi_target, ft0,
+        wm_old, wm_new, keys, mid_cols, live, pane, init_leaves,
+    ):
+        """Advance the ring from its current head to ``hi_target`` in
+        safe chunks when one step spans more panes than the ring covers
+        (a batch with a large event-time jump, or a stream gap).
+
+        Each iteration (1) picks the largest head advance that neither
+        evicts a slot with due-but-unfired windows nor strips coverage
+        from a record not yet scattered, (2) retargets, (3) scatters the
+        newly covered records, and (4) fires every end the watermark and
+        the scatter frontier both allow (``wm_eff``): ends above the
+        frontier could still receive contributions from records waiting
+        in later chunks. Empty gaps are skipped in one hop (occupancy
+        test), so the loop converges in ~panes_per_window/(N - P)
+        iterations per occupied cluster — and in exactly ONE iteration
+        whenever the fast-path predicate in ``_step`` would have held.
+
+        Flink parity: a record-at-a-time runtime interleaves window
+        fires with arrivals in exactly this order — each record lands
+        before the watermark that its successors raise can fire its
+        windows (reference chapter3/README.md:195-213)."""
+        ring = self.ring
+        n, kloc = ring.n_slots, self.local_key_capacity
+        g, p_win = ring.pane_ms, ring.panes_per_window
+        INF = jnp.int64(2**62)
+        v = lambda x: pane_ops.vary(x, self.vary_axes)
+
+        def gmin(x, mask):
+            m = jnp.min(jnp.where(mask, x, INF))
+            return -self._global_max(-m)
+
+        def cond(c):
+            return c[0] | (c[1] < hi_target)
+
+        def body(c):
+            (
+                first, hi_cur, scattered_hi, planes, cnt, slot_pane,
+                ft, evicted, emission, pending,
+            ) = c
+            occ = jnp.any(cnt.reshape(n, kloc) > 0, axis=1)
+            unsafe = occ & ((slot_pane + p_win) * g - 1 > ft)
+            unsafe_min = gmin(slot_pane, unsafe)
+            unscat = live & (pane > scattered_hi)
+            min_unscat = gmin(pane, unscat)
+            hi_next = jnp.minimum(
+                jnp.asarray(hi_target),
+                jnp.minimum(unsafe_min + (n - 1), min_unscat + (n - p_win)),
+            )
+            hi_next = jnp.maximum(hi_next, hi_cur)
+
+            def do_rt(_):
+                p2, c2, sp2, ev = pane_ops.retarget_rows(
+                    [pl.reshape(n, kloc) for pl in planes],
+                    cnt.reshape(n, kloc),
+                    slot_pane, hi_next, ft, ring, init_leaves,
+                )
+                return [pl.reshape(-1) for pl in p2], c2.reshape(-1), sp2, ev
+
+            def no_rt(_):
+                return (
+                    list(planes), cnt, slot_pane,
+                    v(jnp.zeros((), dtype=jnp.int64)),
+                )
+
+            planes2, cnt2, slot_pane2, ev = jax.lax.cond(
+                hi_next > hi_cur, do_rt, no_rt, operand=None
+            )
+            smask = unscat & (pane <= hi_next)
+            planes2, cnt2, touched = self._scatter_words(
+                planes2, cnt2, keys, mid_cols, smask, pane
+            )
+            is_final = hi_next >= hi_target
+            wm_eff = jnp.where(
+                is_final, wm_new, jnp.minimum(wm_new, hi_next * g - 1)
+            )
+            emission, ft2, pending = self._fire_dense(
+                planes2, cnt2, slot_pane2, hi_next, wm_old, wm_eff, ft,
+                touched, emission_carry=emission, budget_on=is_final,
+            )
+            return (
+                jnp.asarray(False), hi_next, hi_next, planes2, cnt2,
+                slot_pane2, ft2, evicted + ev, emission, pending,
+            )
+
+        carry0 = (
+            jnp.asarray(True),
+            jnp.max(slot_pane),          # current head: top targeted pane
+            -INF,                        # nothing scattered yet
+            list(planes), cnt, slot_pane, ft0,
+            v(jnp.zeros((), dtype=jnp.int64)),
+            self._zero_emission_carry(),
+            # pending derives from replicated fire scalars: unvarying
+            jnp.zeros((), dtype=jnp.int64),
+        )
+        (
+            _, _, _, planes, cnt, slot_pane, ft, evicted, emission, pending,
+        ) = jax.lax.while_loop(cond, body, carry0)
+        return planes, cnt, slot_pane, ft, evicted, emission, pending
+
+    def _zero_emission_carry(self):
+        cap = self.cfg.alert_capacity
+        out_dtypes = [
+            self._acc_dtype(kd) for kd in self.post_chain.out_kinds
+        ] + [np.int32, np.int64]  # + key, window_end
+        v = lambda x: pane_ops.vary(x, self.vary_axes)
+        return (
+            [v(jnp.zeros((cap,), dtype=dt)) for dt in out_dtypes],
+            v(jnp.zeros((), dtype=jnp.int32)),
+            v(jnp.zeros((), dtype=jnp.int64)),
+            v(jnp.zeros((), dtype=jnp.int64)),
+        )
 
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
@@ -703,50 +824,104 @@ class WindowProgram(BaseProgram):
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
-        # ring retarget rewrites the whole [N, K] state, so gate it on an
-        # actual pane-boundary advance (most steps stay inside one pane);
-        # the reshape round-trip copies the planes but only on this rare
-        # path — the per-batch scatter stays reshape-free
         init_leaves = [
             jnp.asarray(ident, dtype=p.dtype)
             for p, ident in zip(state["planes"], self._plane_identities())
         ]
         n_slots, kloc = ring.n_slots, self.local_key_capacity
+        ft0 = state["fired_through"]
 
-        def do_retarget(_):
-            planes2d, cnt2d, slot_pane2, evicted = pane_ops.retarget_rows(
-                [p.reshape(n_slots, kloc) for p in state["planes"]],
-                state["cnt"].reshape(n_slots, kloc),
-                state["slot_pane"], hi,
-                state["fired_through"], ring, init_leaves,
+        # ---- fast path vs jump sweep ------------------------------------
+        # The fast path (retarget -> scatter -> one fire pass) is only
+        # sound when (a) retargeting to `hi` evicts no slot whose windows
+        # still owe fires, and (b) every live record's pane fits the ring
+        # at `hi` (pane > hi - N). A large event-time jump — one batch
+        # spanning more panes than the ring, or a stream gap — breaks
+        # both: due ends would be evicted unfired, and old/new panes
+        # would alias the same slot mod N (observed as impossible window
+        # sums). The sweep advances the ring in safe chunks instead.
+        target_t = pane_ops.slot_targets(hi, ring)
+        stale_t = state["slot_pane"] != target_t
+        slot_last_end = (state["slot_pane"] + ring.panes_per_window) * ring.pane_ms
+        # slot_pane < 0 marks virgin targets (hi starts at -1): they hold
+        # nothing, so retargeting them is always safe — without this the
+        # cold-start batch would detour through the sweep
+        may_evict = self._global_max(
+            jnp.max(
+                jnp.where(
+                    stale_t & (slot_last_end - 1 > ft0) & (state["slot_pane"] >= 0),
+                    1,
+                    0,
+                )
+            )
+        ) > 0
+        min_live_pane = -self._global_max(
+            jnp.max(jnp.where(live, -pane, -(2**62)))
+        )
+        fast_ok = (~may_evict) & (min_live_pane > hi - n_slots)
+
+        def fast_path(op):
+            planes, cnt = op
+
+            def do_retarget(_):
+                planes2d, cnt2d, slot_pane2, evicted = pane_ops.retarget_rows(
+                    [p.reshape(n_slots, kloc) for p in planes],
+                    cnt.reshape(n_slots, kloc),
+                    state["slot_pane"], hi, ft0, ring, init_leaves,
+                )
+                return (
+                    [p.reshape(-1) for p in planes2d],
+                    cnt2d.reshape(-1),
+                    slot_pane2,
+                    evicted,
+                )
+
+            def skip_retarget(_):
+                return (
+                    list(planes),
+                    cnt,
+                    state["slot_pane"],
+                    pane_ops.vary(jnp.zeros((), dtype=jnp.int64), self.vary_axes),
+                )
+
+            planes2, cnt2, slot_pane, evicted = jax.lax.cond(
+                hi > state["hi"], do_retarget, skip_retarget, operand=None
+            )
+            planes2, cnt2, touched = self._scatter_words(
+                planes2, cnt2, keys, mid_cols, live, pane
+            )
+            emission, new_ft, n_pending = self._fire_dense(
+                planes2, cnt2, slot_pane, hi, wm_old, wm_new, ft0, touched,
             )
             return (
-                [p.reshape(-1) for p in planes2d],
-                cnt2d.reshape(-1),
-                slot_pane2,
-                evicted,
+                planes2, cnt2, slot_pane, new_ft, evicted,
+                emission, n_pending,
             )
 
-        def skip_retarget(_):
-            return (
-                list(state["planes"]),
-                state["cnt"],
-                state["slot_pane"],
-                pane_ops.vary(jnp.zeros((), dtype=jnp.int64), self.vary_axes),
+        def sweep_path(op):
+            planes, cnt = op
+            return self._sweep(
+                planes, cnt, state["slot_pane"], hi, ft0,
+                wm_old, wm_new, keys, mid_cols, live, pane, init_leaves,
             )
 
-        planes, cnt, slot_pane, evicted = jax.lax.cond(
-            hi > state["hi"], do_retarget, skip_retarget, operand=None
+        (
+            planes, cnt, slot_pane, new_ft, evicted,
+            (emit_cols, emit_count, overflow, n_fired), n_pending,
+        ) = jax.lax.cond(
+            fast_ok, fast_path, sweep_path,
+            (list(state["planes"]), state["cnt"]),
         )
-        planes, cnt, touched = self._scatter_words(
-            planes, cnt, keys, mid_cols, live, pane
+        # ends whose last pane fell below ring coverage can never fire
+        # (or refire) again — advance fired_through past them so the
+        # fast-path soundness predicate doesn't re-trip forever after a
+        # sweep that ended on empty panes
+        new_ft = jnp.maximum(
+            new_ft,
+            jnp.minimum(wm_new, (hi - n_slots + 1) * ring.pane_ms - 1),
         )
-
-        emit_valid, emit_cols, overflow, new_ft, n_pending, n_fired = (
-            self._fire_dense(
-                planes, cnt, slot_pane, hi, wm_old, wm_new,
-                state["fired_through"], touched,
-            )
+        emit_valid = (
+            jnp.arange(self.cfg.alert_capacity, dtype=jnp.int32) < emit_count
         )
 
         n_shards = max(1, self.cfg.parallelism)
