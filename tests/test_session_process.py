@@ -1,0 +1,187 @@
+"""Session windows with a full-window ProcessWindowFunction.
+
+Combines the reference's session-window surface (chapter3/README.md:
+412-428) with its ProcessWindowFunction contract (chapter2/README.md:
+177-196): elements buffer per session; on fire the user function sees
+key, window context ([min_ts, max_ts + gap)), and every element.
+Checked against a record-at-a-time oracle (median per session, like
+ComputeCpuMiddle but session-windowed) across batch sizes.
+"""
+
+import numpy as np
+import pytest
+
+from tpustream import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple2,
+)
+from tpustream.api.windows import EventTimeSessionWindows
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+GAP_MS = 10_000
+DELAY_MS = 2_000
+
+
+def parse(value: str) -> Tuple2:
+    items = value.split(" ")
+    return Tuple2(items[1], int(items[2]))
+
+
+class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+    def __init__(self):
+        super().__init__(Time.milliseconds(DELAY_MS))
+
+    def extract_timestamp(self, value: str) -> int:
+        return int(value.split(" ")[0])
+
+
+def median_process(key, context, elements, out):
+    vals = sorted(e.f1 for e in elements)
+    if not vals:
+        out.collect(Tuple2(key, 0.0))
+    elif len(vals) % 2 == 1:
+        out.collect(Tuple2(key, float(vals[len(vals) // 2])))
+    else:
+        out.collect(
+            Tuple2(key, (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2)
+        )
+
+
+def oracle(records, gap_ms=GAP_MS):
+    """Per-key session merge; median of each session's values. Late
+    records (solo session closed at arrival watermark) are dropped."""
+    wm = -(2**62)
+    open_sessions = {}  # key -> list of [min_ts, max_ts, values]
+    out = []
+
+    def fire(new_wm):
+        for key in sorted(open_sessions):
+            keep = []
+            for s in sorted(open_sessions[key], key=lambda s: s[0]):
+                if s[1] + gap_ms - 1 <= new_wm:
+                    vals = sorted(s[2])
+                    m = (
+                        float(vals[len(vals) // 2])
+                        if len(vals) % 2
+                        else (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2
+                    )
+                    out.append((key, m))
+                else:
+                    keep.append(s)
+            open_sessions[key] = keep
+
+    for ts, key, v in records:
+        if ts + gap_ms - 1 <= wm:
+            continue
+        sess = open_sessions.setdefault(key, [])
+        merged = [ts, ts, [v]]
+        rest = []
+        for s in sess:
+            if s[0] - gap_ms < merged[1] and merged[0] - gap_ms < s[1]:
+                merged = [
+                    min(s[0], merged[0]),
+                    max(s[1], merged[1]),
+                    s[2] + merged[2],
+                ]
+            else:
+                rest.append(s)
+        open_sessions[key] = rest + [merged]
+        new_wm = max(wm, ts - DELAY_MS)
+        if new_wm > wm:
+            fire(new_wm)
+            wm = new_wm
+    fire(2**62)
+    return out
+
+
+def run_job(lines, batch_size=2):
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=batch_size, key_capacity=64)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines))
+    handle = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .process(median_process)
+        .collect()
+    )
+    env.execute("session-median")
+    return [(t.f0, t.f1) for t in handle.items]
+
+
+def _records():
+    rng = np.random.default_rng(5)
+    t = 1_000_000
+    recs = []
+    for burst in range(8):
+        key = f"k{burst % 3}"
+        for j in range(int(rng.integers(1, 6))):
+            recs.append((t + j * 1500, key, int(rng.integers(1, 100))))
+        t += int(rng.integers(GAP_MS + 3000, 3 * GAP_MS))
+    return recs
+
+
+@pytest.mark.parametrize("batch_size", [1, 4, 64])
+def test_session_process_median_matches_oracle(batch_size):
+    recs = _records()
+    lines = [f"{ts} {key} {v}" for ts, key, v in recs]
+    got = run_job(lines, batch_size=batch_size)
+    want = oracle(recs)
+    assert sorted(got) == sorted(want)
+    assert len(want) >= 8  # the scenario actually produced sessions
+
+
+def test_session_process_context_bounds():
+    seen = {}
+
+    def probe(key, context, elements, out):
+        seen[key] = (context.start, context.end, len(elements))
+        out.collect(Tuple2(key, float(len(elements))))
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=4, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    lines = [
+        "1000000 a 1",
+        "1003000 a 2",
+        "1060000 a 9",  # wm passes first session; also closes at EOS
+    ]
+    text = env.add_source(ReplaySource(lines))
+    handle = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .process(probe)
+        .collect()
+    )
+    env.execute("session-ctx")
+    # two sessions fired; `seen` keeps the LAST one: [1060000, 1070000)
+    assert [(t.f0, t.f1) for t in handle.items] == [("a", 2.0), ("a", 1.0)]
+    assert seen["a"] == (1060000, 1060000 + GAP_MS, 1)
+
+
+def test_sharded_session_process_raises_clearly():
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=4, key_capacity=16, parallelism=2)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(["1000000 a 1"]))
+    (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .process(median_process)
+        .collect()
+    )
+    with pytest.raises(NotImplementedError, match="sharded session"):
+        env.execute("sharded-session-process")

@@ -48,19 +48,27 @@ def initialize(
     Explicit arguments mirror ``jax.distributed.initialize``.
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
         return
+    # NOTE: no jax.process_count()/jax.devices() probes here — touching
+    # the backend initializes XLA, after which jax.distributed refuses
+    # to start (verified by the 2-process test)
     if coordinator is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
         if num_processes is None and process_id is None:
             # single-process run (tests, one-host dev): nothing to join
             _initialized = True
             return
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # a managed launcher (TPU pod runtime) may have joined already;
+        # anything else is a real failure the job must see
+        if "already" not in str(e):
+            raise
     _initialized = True
 
 

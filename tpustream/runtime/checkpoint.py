@@ -35,7 +35,9 @@ import numpy as np
 # leaves (window_fires / late_dropped), changing the snapshot treedef
 # v3: process state gained exchange_overflow (sharded process windows);
 # meta records parallelism because the sharded key layout is shard-major
-FORMAT_VERSION = 3
+# v4: stateless state is a real alert_overflow counter (device-compacted
+# emissions); session process() programs add cell_min/max/pending_clear
+FORMAT_VERSION = 4
 _META_KEY = "__meta__"
 
 

@@ -40,6 +40,7 @@ class CountWindowProgram(WindowProgram):
 
     accepted_kinds = ("count",)
     fires_on_clock = False
+    main_emission_prefix = False  # emissions ride the sorted batch order
 
     def __init__(self, plan: JobPlan, cfg):
         BaseProgram.__init__(self, plan, cfg)

@@ -395,12 +395,31 @@ class Runner:
         # device_time_s still reflects device + transfer occupancy
         with Stopwatch() as sw:
             cnts = jax.device_get(counts)
-            fetch = {
-                name: stream
-                for name, stream in emissions.items()
-                if cnts.get(name, 1)
-                and (name != "late" or self.side_sinks)
-            }
+            fetch = {}
+            for name, stream in emissions.items():
+                c = cnts.get(name, 1)
+                if not c or (name == "late" and not self.side_sinks):
+                    continue
+                if (
+                    name == "main"
+                    and self.program.main_emission_prefix
+                    and self.cfg.parallelism <= 1
+                    # sharded emissions stack one prefix PER SHARD —
+                    # the global buffer has no single count-row prefix
+                ):
+                    # valid rows are a compacted prefix: fetch the next
+                    # power-of-two past the count, not the whole
+                    # alert_capacity buffer (bucketing keeps the number
+                    # of device slice programs bounded)
+                    cap = int(stream["mask"].shape[0])
+                    b = min(cap, 1 << max(4, (int(c) - 1).bit_length()))
+                    stream = jax.tree_util.tree_map(
+                        lambda a: a[:b]
+                        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == cap
+                        else a,
+                        stream,
+                    )
+                fetch[name] = stream
             fetched = jax.device_get(fetch) if fetch else {}
         self.metrics.step_times_s.append(sw.elapsed)
         self._dispatch(fetched, t_batch)

@@ -84,6 +84,54 @@ class ProcessWindowProgram(WindowProgram):
         # (WindowProgram's override is for its flat word-plane layout)
         return BaseProgram.state_specs(self, state)
 
+    def _append_elements(self, buf, cnt, keys, mid_cols, live, pane):
+        """Append the batch's live records to their (key, slot) element
+        buffers: sort by cell, rank within cell, write at cnt+rank
+        (overflow past process_buffer_capacity counts, never corrupts).
+        Shared by the time-window and session process programs. Returns
+        (buf, cnt, overflow, touched_slots, cell)."""
+        from ..ops.segments import segment_tails as _segtails
+
+        ring = self.ring
+        n = ring.n_slots
+        cap = self.cfg.process_buffer_capacity
+        k = cnt.shape[0]
+        slot = jnp.mod(pane, n)
+        cell = keys.astype(jnp.int64) * n + slot
+        perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=k * n)
+        rank = segment_ranks(seg_starts)
+        cell_sorted = jnp.clip(sc, 0, k * n - 1)
+        base = cnt.reshape(-1)[cell_sorted]
+        write_pos = base.astype(jnp.int64) + rank
+        fits = sv & (write_pos < cap)
+        flat_idx = jnp.where(fits, cell_sorted * cap + write_pos, k * n * cap)
+        sorted_cols = [c[perm] for c in mid_cols]
+        buf = [
+            bb.reshape(-1)
+            .at[flat_idx]
+            .set(col, mode="drop", unique_indices=True)
+            .reshape(k, n, cap)
+            for bb, col in zip(buf, sorted_cols)
+        ]
+        overflow = jnp.sum(sv & ~fits)
+        tails = _segtails(seg_starts) & sv
+        seg_count = rank + 1
+        cnt = (
+            cnt.reshape(-1)
+            .at[jnp.where(tails, cell_sorted, k * n)]
+            .add(jnp.where(tails, seg_count, 0), mode="drop", unique_indices=True)
+            .reshape(k, n)
+        )
+        if self.allowed_lateness_ms > 0:
+            touched = (
+                jnp.zeros((n + 1,), dtype=jnp.int32)
+                .at[jnp.where(tails, jnp.mod(sc, n), n)]
+                .max(1, mode="drop")
+            )[:n] > 0
+        else:
+            touched = jnp.zeros((n,), dtype=bool)
+        return buf, cnt, overflow, touched, cell
+
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
         ring = self.ring
@@ -134,42 +182,9 @@ class ProcessWindowProgram(WindowProgram):
         slot_pane = target
 
         # ---- append batch elements to their cells ------------------------
-        slot = jnp.mod(pane, n)
-        cell = keys.astype(jnp.int64) * n + slot
-        perm, sc, sv, seg_starts = sort_by_key(cell, live, max_key=k * n)
-        rank = segment_ranks(seg_starts)
-        cell_sorted = jnp.clip(sc, 0, k * n - 1)
-        base = cnt.reshape(-1)[cell_sorted]
-        write_pos = base.astype(jnp.int64) + rank
-        fits = sv & (write_pos < cap)
-        flat_idx = jnp.where(fits, cell_sorted * cap + write_pos, k * n * cap)
-        sorted_cols = [c[perm] for c in mid_cols]
-        buf = [
-            bb.reshape(-1)
-            .at[flat_idx]
-            .set(col, mode="drop", unique_indices=True)
-            .reshape(k, n, cap)
-            for bb, col in zip(buf, sorted_cols)
-        ]
-        overflow = jnp.sum(sv & ~fits)
-        from ..ops.segments import segment_tails as _segtails
-
-        tails = _segtails(seg_starts) & sv
-        seg_count = rank + 1
-        cnt = (
-            cnt.reshape(-1)
-            .at[jnp.where(tails, jnp.clip(sc, 0, k * n - 1), k * n)]
-            .add(jnp.where(tails, seg_count, 0), mode="drop", unique_indices=True)
-            .reshape(k, n)
+        buf, cnt, overflow, touched, cell = self._append_elements(
+            buf, cnt, keys, mid_cols, live, pane
         )
-        if self.allowed_lateness_ms > 0:
-            touched = (
-                jnp.zeros((n + 1,), dtype=jnp.int32)
-                .at[jnp.where(tails, jnp.mod(sc, n), n)]
-                .max(1, mode="drop")
-            )[:n] > 0
-        else:
-            touched = jnp.zeros((n,), dtype=bool)
 
         # ---- fire candidates --------------------------------------------
         cand, ends, fire = pane_ops.fire_candidates(hi, wm_old, wm_new, ring)

@@ -23,12 +23,16 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..api.functions import Collector, WindowContext, as_callable
+from ..api.tuples import make_tuple
 from ..ops import panes as pane_ops
 from ..ops import sessions as sess_ops
 from ..ops.panes import W0
 from ..ops.sessions import TS_MAX
 from .plan import JobPlan
+from .process_program import ProcessWindowProgram
 from .window_program import WindowProgram
 
 
@@ -312,3 +316,243 @@ class SessionWindowProgram(WindowProgram):
             "late": {"mask": late, "cols": tuple(mid_cols)},
         }
         return new_state, emissions
+
+
+class SessionProcessProgram(ProcessWindowProgram):
+    """Session windows with a full-window ProcessWindowFunction.
+
+    Element buffers follow ProcessWindowProgram's [keys, slots, cap]
+    layout; session boundaries follow SessionWindowProgram's per-cell
+    min/max-timestamp run detection (gap panes, only adjacent panes can
+    merge). Fires are EDGE-TRIGGERED — a run fires on the step whose
+    watermark first passes ``run_max + gap - 1`` — and the fired run's
+    cells are cleared at the START of the next step, because the host
+    gathers the fired elements from post-step state in between
+    (``emissions_reference_state`` keeps the executor synchronous).
+
+    Reference surface: session windows (chapter3/README.md:412-428) x
+    ProcessWindowFunction (chapter2/README.md:177-196). Allowed lateness
+    on sessions stays unsupported, like the reduce/aggregate program.
+    """
+
+    accepted_kinds = ("session",)
+
+    def __init__(self, plan: JobPlan, cfg):
+        st = plan.stateful
+        if st.allowed_lateness_ms > 0:
+            raise NotImplementedError(
+                "allowed lateness on session windows is not supported; the "
+                "reference documents lateness for time windows only "
+                "(chapter3/README.md:209-228)"
+            )
+        super().__init__(plan, cfg)
+
+    def _make_ring(self, spec, cfg):
+        return pane_ops.make_ring_spec(
+            spec.gap_ms,
+            spec.gap_ms,
+            self.delay_ms,
+            0,
+            cfg.pane_ring_slack + cfg.session_extra_panes,
+        )
+
+    @property
+    def gap_ms(self) -> int:
+        return self.plan.stateful.window.gap_ms
+
+    def init_state(self):
+        s = ProcessWindowProgram.init_state(self)
+        k, n = self.cfg.key_capacity, self.ring.n_slots
+        s["cell_min"] = jnp.full((k, n), TS_MAX, dtype=jnp.int64)
+        s["cell_max"] = jnp.full((k, n), W0, dtype=jnp.int64)
+        s["pending_clear"] = jnp.zeros((k, n), dtype=bool)
+        return s
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        ring = self.ring
+        n, gap = ring.n_slots, self.gap_ms
+
+        wm_old = state["wm"]
+        batch_max = self._global_max(jnp.max(jnp.where(mask, ts, W0)))
+        new_max = jnp.maximum(state["max_ts"], batch_max)
+        wm_new = jnp.maximum(
+            wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
+        )
+
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+        k = state["cnt"].shape[0]
+
+        late = (ts + gap - 1 <= wm_old) & mask
+        live = mask & ~late
+
+        pane = pane_ops.pane_of(ts, ring.pane_ms)
+        batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
+        hi = jnp.maximum(state["hi"], batch_hi)
+        uncov = live & (pane <= hi - n)
+        live = live & ~uncov
+        n_uncov = self._global_sum(jnp.sum(uncov).astype(jnp.int64))
+
+        # ---- apply the PREVIOUS step's fired-run clears ------------------
+        # (the host consumed those buffers between steps)
+        pc = state["pending_clear"]
+        cnt0 = jnp.where(pc, 0, state["cnt"])
+        cmin0 = jnp.where(pc, TS_MAX, state["cell_min"])
+        cmax0 = jnp.where(pc, W0, state["cell_max"])
+
+        # ---- retarget ----------------------------------------------------
+        target = pane_ops.slot_targets(hi, ring)
+        stale = state["slot_pane"] != target
+        unfired_cell = stale[None, :] & (cnt0 > 0) & (cmax0 + gap - 1 > wm_old)
+        evicted = jnp.sum(jnp.where(unfired_cell, cnt0, 0)).astype(jnp.int64)
+        cnt = jnp.where(stale[None, :], 0, cnt0)
+        cmin = jnp.where(stale[None, :], TS_MAX, cmin0)
+        cmax = jnp.where(stale[None, :], W0, cmax0)
+        buf = state["buf"]
+        slot_pane = target
+
+        # ---- append batch elements to their cells ------------------------
+        buf, cnt, overflow, _touched, cell = self._append_elements(
+            buf, cnt, keys, mid_cols, live, pane
+        )
+        live_cell = jnp.where(live, cell, k * n)
+        cmin = (
+            cmin.reshape(-1)
+            .at[live_cell]
+            .min(ts, mode="drop")
+            .reshape(k, n)
+        )
+        cmax = (
+            cmax.reshape(-1)
+            .at[live_cell]
+            .max(ts, mode="drop")
+            .reshape(k, n)
+        )
+
+        # ---- session runs + edge-triggered fires -------------------------
+        slot_o, pane_ids = sess_ops.ascending_slot_order(hi, ring)
+        occ = (slot_pane[slot_o][None, :] == pane_ids[None, :]) & (
+            cnt[:, slot_o] > 0
+        )
+        mn = jnp.where(occ, cmin[:, slot_o], TS_MAX)
+        mx = jnp.where(occ, cmax[:, slot_o], W0)
+        link, run_end = sess_ops.session_runs(occ, mn, mx, gap)
+        fire = (
+            run_end & (mx + gap - 1 <= wm_new) & (mx + gap - 1 > wm_old)
+        )
+        cleared_o = sess_ops.propagate_to_run(fire, link)
+        inv = jnp.mod(
+            jnp.arange(n, dtype=jnp.int64) - (hi + 1), n
+        ).astype(jnp.int32)
+        pending_clear = cleared_o[:, inv]
+        n_fired = jnp.sum(fire).astype(jnp.int64)
+
+        new_state = {
+            "buf": buf,
+            "cnt": cnt,
+            "slot_pane": slot_pane,
+            "hi": hi,
+            "wm": wm_new,
+            "max_ts": new_max,
+            "cell_min": cmin,
+            "cell_max": cmax,
+            "pending_clear": pending_clear,
+            "evicted_unfired": state["evicted_unfired"]
+            + self._global_sum(evicted)
+            + n_uncov,
+            "buffer_overflow": state["buffer_overflow"]
+            + self._global_sum(overflow),
+            "exchange_overflow": state["exchange_overflow"]
+            + self._global_sum(xovf),
+            "late_dropped": state["late_dropped"]
+            + (
+                self._global_sum(jnp.sum(late).astype(jnp.int64))
+                if self.count_late_as_dropped
+                else 0
+            ),
+        }
+        emissions = {
+            "process_fire": {
+                "fire": n_fired[None],
+                "wm": wm_new[None],
+            },
+            "late": {"mask": late, "cols": tuple(mid_cols)},
+        }
+        return new_state, emissions
+
+    # ------------------------------------------------------------------
+    def evaluate_fires(self, state, fire_info, post_ops, emit):
+        """Host callback: the fired runs are exactly the connected
+        components of ``state["pending_clear"]`` in ascending pane order
+        (distinct runs are separated by at least one empty — hence never
+        cleared — pane), so the host never re-derives the device's run
+        detection or fire predicate. Run the user ProcessWindowFunction
+        over each component's buffered elements in pane order; Flink's
+        session TimeWindow is [min_ts, max_ts + gap)."""
+        if int(np.asarray(fire_info["fire"]).reshape(-1)[0]) == 0:
+            return 0, 0
+        ring = self.ring
+        n, gap = ring.n_slots, self.gap_ms
+        cap = self.cfg.process_buffer_capacity
+        wm = int(np.asarray(fire_info["wm"]).reshape(-1)[0])
+        cnt = np.asarray(state["cnt"])
+        cmin = np.asarray(state["cell_min"])
+        cmax = np.asarray(state["cell_max"])
+        hi = int(np.asarray(state["hi"]))
+        bufs = [np.asarray(b) for b in state["buf"]]
+        kinds, tables = self.mid_kinds, self.mid_tables
+        key_table = tables[self.key_pos]
+
+        o = np.arange(n, dtype=np.int64)
+        pane_ids = hi - n + 1 + o
+        slot_o = (pane_ids % n).astype(np.int64)
+        cleared = np.asarray(state["pending_clear"])[:, slot_o]
+
+        emitted = 0
+        fired = 0
+        for key_row in np.nonzero(cleared.any(axis=1))[0]:
+            row = cleared[key_row]
+            # maximal runs of cleared panes = the fired sessions
+            starts = np.nonzero(row & ~np.concatenate(([False], row[:-1])))[0]
+            ends = np.nonzero(row & ~np.concatenate((row[1:], [False])))[0]
+            for os_, oe in zip(starts, ends):
+                elements = []
+                start_ts, end_ts = TS_MAX, W0
+                for oo in range(int(os_), int(oe) + 1):
+                    s = int(slot_o[oo])
+                    rows = min(int(cnt[key_row, s]), cap)
+                    if rows:
+                        start_ts = min(start_ts, int(cmin[key_row, s]))
+                        end_ts = max(end_ts, int(cmax[key_row, s]))
+                    for r in range(rows):
+                        vals = [
+                            self._value(kd, tb, b[key_row, s, r])
+                            for kd, tb, b in zip(kinds, tables, bufs)
+                        ]
+                        elements.append(
+                            vals[0] if len(vals) == 1 else make_tuple(*vals)
+                        )
+                key_id = int(key_row)
+                key_val = (
+                    key_table.lookup(key_id)
+                    if key_table is not None
+                    else key_id
+                )
+                ctx = WindowContext(start_ts, end_ts + gap, wm)
+                fired += 1
+                out = Collector()
+                self.process_fn(key_val, ctx, elements, out)
+                for item in out.items:
+                    keep = True
+                    for op, fn in post_ops:
+                        if op == "map":
+                            item = as_callable(fn, "map")(item)
+                        else:
+                            keep = keep and bool(
+                                as_callable(fn, "filter")(item)
+                            )
+                    if keep:
+                        emit(item, key_id % max(1, self.n_shards))
+                        emitted += 1
+        return emitted, fired

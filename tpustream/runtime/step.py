@@ -78,6 +78,13 @@ class BaseProgram:
     # pipelining (StreamConfig.async_depth) is forced off
     emissions_reference_state = False
 
+    # True when the "main" emission's valid rows are a compacted PREFIX
+    # of the buffer (window/session append-compaction): the executor can
+    # then fetch only ~count rows instead of the full alert_capacity
+    # buffer — on a thin host link that is the difference between
+    # kilobytes and megabytes per firing step
+    main_emission_prefix = False
+
     # -- SPMD hooks: identity on one chip, mesh collectives when sharded --
     n_shards = 1
     vary_axes: tuple = ()
@@ -107,23 +114,36 @@ class BaseProgram:
 
 
 class StatelessProgram(BaseProgram):
-    """map/filter-only pipeline (reference chapter1 job, SURVEY.md §3.1)."""
+    """map/filter-only pipeline (reference chapter1 job, SURVEY.md §3.1).
+
+    Emissions are compacted on device into a prefix buffer so the host
+    fetches ~alert-count rows, not the whole batch — for a sparse filter
+    like the >90 threshold that is a ~100x cut in D2H bytes."""
 
     fires_on_clock = False
+    main_emission_prefix = True
 
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         super().__init__(plan, cfg)
         self.out_kinds = self.mid_kinds
         self.out_tables = self.mid_tables
+        # never lossy: a filterless pipeline emits the full batch
+        self.emit_capacity = max(cfg.alert_capacity, cfg.batch_size)
 
     def init_state(self):
-        return {"_": jnp.zeros((), dtype=jnp.int32)}
+        return {"alert_overflow": jnp.zeros((), dtype=jnp.int64)}
 
     def _step(self, state, cols, valid, ts, wm_lower):
+        from ..ops import panes as pane_ops
+
         out_cols, mask = self.pre_chain.apply(cols, valid)
-        return state, {
-            "main": {"mask": mask, "cols": tuple(out_cols)}
-        }
+        _, emit_valid, overflow, gathered = pane_ops.compact(
+            mask, list(out_cols), self.emit_capacity
+        )
+        return (
+            {"alert_overflow": state["alert_overflow"] + overflow},
+            {"main": {"mask": emit_valid, "cols": tuple(gathered)}},
+        )
 
 
 class RollingProgram(BaseProgram):
@@ -248,6 +268,16 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
 
             return CountWindowProgram(plan, cfg)
         if plan.stateful.window is not None and plan.stateful.window.kind == "session":
+            if plan.stateful.apply_kind == "process":
+                if sharded:
+                    raise NotImplementedError(
+                        "sharded session windows with a "
+                        "ProcessWindowFunction are not supported yet; run "
+                        "at parallelism 1 or use reduce/aggregate"
+                    )
+                from .session_program import SessionProcessProgram
+
+                return SessionProcessProgram(plan, cfg)
             if sharded:
                 from .sharded import ShardedSessionWindowProgram
 
