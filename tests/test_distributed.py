@@ -96,7 +96,7 @@ WORKER = textwrap.dedent(
     rng = np.random.default_rng(0)
     gkeys = rng.integers(0, 16, 32).astype(np.int32)
     mk = lambda a, sh: jax.make_array_from_callback(
-        a.shape, NamedSharding(mesh, P(AXIS)), lambda idx: a[idx]
+        a.shape, sh, lambda idx: a[idx]
     )
     keys = mk(gkeys, sharding)
     valsg = mk((gkeys * 7).astype(np.int32), sharding)

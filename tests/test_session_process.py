@@ -138,6 +138,37 @@ def test_session_process_median_matches_oracle(batch_size):
     assert len(want) >= 8  # the scenario actually produced sessions
 
 
+def test_adjacent_pane_sessions_do_not_merge():
+    """Two same-key sessions whose records are gap..2*gap-1 apart sit in
+    ADJACENT panes yet are distinct sessions; when both fire in one step
+    the host must split them with the device's link predicate, not pane
+    contiguity (regression: they were merged into one window)."""
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=8, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    lines = [
+        "1000000 a 1",   # pane 100
+        "1019999 a 2",   # pane 101, 19999 ms later: separate session
+        "1100000 b 3",   # watermark passes both ends in the same step
+    ]
+    text = env.add_source(ReplaySource(lines))
+    handle = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .process(median_process)
+        .collect()
+    )
+    env.execute("adjacent-sessions")
+    assert sorted((t.f0, t.f1) for t in handle.items) == [
+        ("a", 1.0),
+        ("a", 2.0),
+        ("b", 3.0),
+    ]
+
+
 def test_session_process_context_bounds():
     seen = {}
 

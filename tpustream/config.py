@@ -84,6 +84,14 @@ class StreamConfig:
     # (full-window process()) force depth 1. Raise past 2 when the
     # link's round-trip latency exceeds a step's device time.
 
+    h2d_compress: bool = True
+    # Lossless host->device transfer compression: int64 record columns
+    # and timestamps ship as int32 deltas against a per-batch base and
+    # re-expand on device. int64 columns dominate batch wire bytes
+    # (timestamps, epoch fields, counters), so this roughly halves H2D
+    # traffic on the host link. A column whose per-batch span exceeds
+    # int32 falls back to raw permanently (one recompile).
+
     # -- misc ---------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_batches: int = 0  # 0 = disabled

@@ -85,6 +85,24 @@ def propagate_to_run(fire_at_end: jnp.ndarray, link: jnp.ndarray) -> jnp.ndarray
     return jnp.flip(jnp.moveaxis(out, 0, -1), axis=-1)
 
 
+def session_links(occ, mn, mx, gap_ms: int, xp=jnp):
+    """THE session boundary predicate: ``link[:, o]`` true when pane o
+    merges with pane o-1 (both occupied AND the inter-pane time gap is
+    below ``gap_ms`` — adjacent occupied panes do NOT always merge, two
+    records can be up to 2*gap-1 apart in adjacent panes).
+
+    ``xp`` selects the array module so the device step (jnp) and the
+    host-side process() evaluation (np) share ONE definition and cannot
+    drift."""
+    prev_occ = xp.concatenate(
+        [xp.zeros_like(occ[:, :1]), occ[:, :-1]], axis=1
+    )
+    prev_mx = xp.concatenate(
+        [xp.full_like(mx[:, :1], W0), mx[:, :-1]], axis=1
+    )
+    return occ & prev_occ & (mn - prev_mx < gap_ms)
+
+
 def session_runs(
     occ: jnp.ndarray,      # [K, O] cell occupied (ascending pane order)
     mn: jnp.ndarray,       # [K, O] per-cell min record ts
@@ -96,13 +114,7 @@ def session_runs(
     Returns (link [K,O], run_end [K,O]): ``link[:, o]`` true when pane o
     merges with pane o-1; ``run_end`` marks the last pane of each run.
     """
-    prev_occ = jnp.concatenate(
-        [jnp.zeros_like(occ[:, :1]), occ[:, :-1]], axis=1
-    )
-    prev_mx = jnp.concatenate(
-        [jnp.full_like(mx[:, :1], W0), mx[:, :-1]], axis=1
-    )
-    link = occ & prev_occ & (mn - prev_mx < gap_ms)
+    link = session_links(occ, mn, mx, gap_ms)
     next_link = jnp.concatenate(
         [link[:, 1:], jnp.zeros_like(link[:, :1])], axis=1
     )

@@ -64,10 +64,11 @@ def initialize(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError as e:
-        # a managed launcher (TPU pod runtime) may have joined already;
-        # anything else is a real failure the job must see
-        if "already" not in str(e):
+    except RuntimeError:
+        # a managed launcher (TPU pod runtime) may have joined already —
+        # verify by the observable effect, not the message text; anything
+        # else is a real failure the job must see
+        if jax.process_count() <= 1:
             raise
     _initialized = True
 

@@ -234,7 +234,15 @@ class Runner:
         self.cfg = cfg
         self.metrics = metrics
         self.program = build_program(plan, cfg)
-        self.step = self._counted_step(self.program.jitted_step())
+        self._inner_step = self.program.jitted_step()
+        # H2D transfer compression: int64 columns and timestamps ship as
+        # int32 deltas against a per-batch base scalar (lossless) and
+        # re-expand on device — on the PCIe/host link these columns are
+        # most of the wire bytes. A column whose per-batch span ever
+        # exceeds int32 is demoted to raw permanently (one recompile).
+        self._col_modes: Optional[tuple] = None
+        self._ts_mode: Optional[str] = None
+        self.step = None  # built on the first batch, when modes are known
         self.state = self.program.init_state()
         self.sinks, self.side_sinks = _make_sinks(plan, cfg)
         self.formatter = EmissionFormatter(
@@ -282,17 +290,64 @@ class Runner:
             )
 
     def _device_inputs(self, batch: Batch, domain: TimeCharacteristic):
-        cols = tuple(jnp.asarray(c.data) for c in batch.columns)
-        valid = jnp.asarray(batch.valid)
+        cols = [np.asarray(c.data) for c in batch.columns]
+        valid = np.asarray(batch.valid)
         if domain == TimeCharacteristic.EventTime and batch.ts is not None:
-            ts = jnp.asarray(batch.ts)
+            ts = np.asarray(batch.ts)
         else:
-            ts = jnp.asarray(
+            ts = np.asarray(
                 batch.proc_ts
                 if batch.proc_ts is not None
                 else np.zeros(batch.n, dtype=np.int64)
             )
-        return cols, valid, ts
+        return self._pack(cols, valid, ts)
+
+    _I32_SPAN = 0x7FFF_FFFF
+
+    def _pack(self, cols, valid, ts):
+        """Numpy-side delta packing per the sticky column modes; demotes
+        a column to raw (and rebuilds the step once) when a batch's
+        valid-row span no longer fits int32."""
+        if self._col_modes is None:
+            compress = self.cfg.h2d_compress
+            self._col_modes = tuple(
+                "d32" if compress and k == "i64" else "raw"
+                for k in self.in_kinds
+            )
+            self._ts_mode = "d32" if compress else "raw"
+        all_valid = bool(valid.all())
+        any_valid = all_valid or bool(valid.any())
+
+        def pack_one(arr, mode):
+            if mode != "d32":
+                return arr, np.int64(0), mode
+            if not any_valid:
+                return np.zeros(arr.shape, np.int32), np.int64(0), mode
+            va = arr if all_valid else arr[valid]
+            lo = va.min()
+            # Python-int span: an int64 subtraction could wrap for
+            # full-range columns and silently pass the check
+            if int(va.max()) - int(lo) > self._I32_SPAN:
+                return arr, np.int64(0), "raw"
+            return (arr - lo).astype(np.int32), np.int64(lo), mode
+
+        packed, bases, modes = [], [], []
+        for arr, mode in zip(cols, self._col_modes):
+            p, b, m = pack_one(arr, mode)
+            packed.append(p)
+            bases.append(b)
+            modes.append(m)
+        ts_p, ts_b, ts_m = pack_one(ts, self._ts_mode)
+        if tuple(modes) != self._col_modes or ts_m != self._ts_mode:
+            self._col_modes, self._ts_mode = tuple(modes), ts_m
+            self.step = None  # rebuild for the demoted layout
+            self._empty_cache = None
+            return self._pack(cols, valid, ts)
+        return tuple(packed), tuple(bases), valid, ts_p, ts_b
+
+    def _ensure_step(self):
+        if self.step is None:
+            self.step = self._counted_step(self._inner_step)
 
     def feed(self, batch: Batch, wm_lower: int, t_batch: Optional[float] = None):
         cfg = self.cfg
@@ -313,10 +368,10 @@ class Runner:
                 valid=batch.valid[start : start + cfg.batch_size],
             )
             padded = sub.pad_to(cfg.batch_size)
-            cols, valid, ts = self._device_inputs(
+            inputs = self._device_inputs(
                 padded, self.plan.time_characteristic
             )
-            self._run_step(cols, valid, ts, wm_lower, t_batch)
+            self._run_step(inputs, wm_lower, t_batch)
             self.metrics.records_in += int(sub.n)
             # with a max_fires_per_step budget, drain deferred window ends
             # BEFORE the next batch can advance the pane ring past them —
@@ -336,29 +391,39 @@ class Runner:
             t_batch = time.perf_counter()
         cfg = self.cfg
         if self._empty_cache is None:
-            cols = tuple(
-                jnp.zeros(
+            cols = [
+                np.zeros(
                     (cfg.batch_size,),
                     dtype=np.int32
                     if k == STR
                     else {"f64": np.float64, "i64": np.int64, "bool": np.bool_}[k],
                 )
                 for k in self.in_kinds
-            )
-            valid = jnp.zeros((cfg.batch_size,), dtype=bool)
-            ts = jnp.zeros((cfg.batch_size,), dtype=jnp.int64)
-            self._empty_cache = (cols, valid, ts)
-        cols, valid, ts = self._empty_cache
-        self._run_step(cols, valid, ts, wm_lower, t_batch)
+            ]
+            valid = np.zeros((cfg.batch_size,), dtype=bool)
+            ts = np.zeros((cfg.batch_size,), dtype=np.int64)
+            self._empty_cache = self._pack(cols, valid, ts)
+        self._run_step(self._empty_cache, wm_lower, t_batch)
         self._drain(wm_lower, t_batch)
 
     def _counted_step(self, inner):
-        """Wrap the program's jitted step to also return one scalar
-        count per emission stream, so the host can skip fetching the
-        batch-sized emission buffers of a step that emitted nothing —
-        on a step with no alerts the only D2H traffic is these scalars."""
+        """Wrap the program's jitted step to (a) re-expand delta-packed
+        int64 columns on device and (b) also return one scalar count per
+        emission stream, so the host can skip fetching the batch-sized
+        emission buffers of a step that emitted nothing — on a step with
+        no alerts the only D2H traffic is these scalars."""
+        col_modes, ts_mode = self._col_modes, self._ts_mode
 
-        def step(state, cols, valid, ts, wm_lower):
+        def expand(p, b, mode):
+            if mode != "d32":
+                return p
+            return p.astype(jnp.int64) + b
+
+        def step(state, packed, bases, valid, ts_p, ts_b, wm_lower):
+            cols = tuple(
+                expand(p, b, m) for p, b, m in zip(packed, bases, col_modes)
+            )
+            ts = expand(ts_p, ts_b, ts_mode)
             state, em = inner(state, cols, valid, ts, wm_lower)
             counts = {}
             for name, stream in em.items():
@@ -370,11 +435,14 @@ class Runner:
 
         return jax.jit(step, donate_argnums=0)
 
-    def _run_step(self, cols, valid, ts, wm_lower: int, t_batch=None):
+    def _run_step(self, inputs, wm_lower: int, t_batch=None):
         """One jitted step + emission dispatch (the only step call site)."""
+        self._ensure_step()
+        packed, bases, valid, ts_p, ts_b = inputs
         with Stopwatch() as sw:
             self.state, emissions, counts = self.step(
-                self.state, cols, valid, ts, jnp.asarray(wm_lower, jnp.int64)
+                self.state, packed, bases, valid, ts_p, ts_b,
+                jnp.asarray(wm_lower, jnp.int64),
             )
             for leaf in counts.values():
                 leaf.copy_to_host_async()
@@ -476,10 +544,9 @@ class Runner:
             # builds the cache and runs one round
             self.flush(wm_lower, t_batch)
             return
-        cols, valid, ts = self._empty_cache
         max_rounds = self.program.ring.n_fire_candidates + 1
         for _ in range(max_rounds):
-            self._run_step(cols, valid, ts, wm_lower, t_batch)
+            self._run_step(self._empty_cache, wm_lower, t_batch)
             if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 

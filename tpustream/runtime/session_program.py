@@ -483,13 +483,15 @@ class SessionProcessProgram(ProcessWindowProgram):
 
     # ------------------------------------------------------------------
     def evaluate_fires(self, state, fire_info, post_ops, emit):
-        """Host callback: the fired runs are exactly the connected
-        components of ``state["pending_clear"]`` in ascending pane order
-        (distinct runs are separated by at least one empty — hence never
-        cleared — pane), so the host never re-derives the device's run
-        detection or fire predicate. Run the user ProcessWindowFunction
-        over each component's buffered elements in pane order; Flink's
-        session TimeWindow is [min_ts, max_ts + gap)."""
+        """Host callback: the fired cells are ``state["pending_clear"]``
+        (the device's decision — no fire predicate is re-derived), split
+        into individual sessions with the SAME boundary predicate the
+        device uses (sess_ops.session_links with numpy): two fired
+        sessions of one key can sit in ADJACENT panes when their records
+        are gap..2*gap-1 apart, so mere pane contiguity is not enough.
+        Runs the user ProcessWindowFunction over each run's buffered
+        elements in pane order; Flink's session TimeWindow is
+        [min_ts, max_ts + gap)."""
         if int(np.asarray(fire_info["fire"]).reshape(-1)[0]) == 0:
             return 0, 0
         ring = self.ring
@@ -508,14 +510,18 @@ class SessionProcessProgram(ProcessWindowProgram):
         pane_ids = hi - n + 1 + o
         slot_o = (pane_ids % n).astype(np.int64)
         cleared = np.asarray(state["pending_clear"])[:, slot_o]
+        mn = np.where(cleared, cmin[:, slot_o], TS_MAX)
+        mx = np.where(cleared, cmax[:, slot_o], W0)
+        link = sess_ops.session_links(cleared, mn, mx, gap, xp=np)
 
         emitted = 0
         fired = 0
         for key_row in np.nonzero(cleared.any(axis=1))[0]:
             row = cleared[key_row]
-            # maximal runs of cleared panes = the fired sessions
-            starts = np.nonzero(row & ~np.concatenate(([False], row[:-1])))[0]
-            ends = np.nonzero(row & ~np.concatenate((row[1:], [False])))[0]
+            rlink = link[key_row]
+            # split fired cells into sessions at non-linked boundaries
+            starts = np.nonzero(row & ~rlink)[0]
+            ends = np.nonzero(row & ~np.concatenate((rlink[1:], [False])))[0]
             for os_, oe in zip(starts, ends):
                 elements = []
                 start_ts, end_ts = TS_MAX, W0
