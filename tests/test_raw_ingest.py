@@ -105,6 +105,51 @@ def test_raw_fallback_decodes_for_non_symbolic_jobs(tmp_path):
     )
 
 
+def test_socket_raw_mode_equals_line_mode():
+    """SocketTextSource(raw=True) must produce the same job output as
+    line mode for the same byte stream (chapter1 threshold job)."""
+    import socket
+    import threading
+
+    lines = [
+        f"1563452051 10.8.22.{i%4} cpu{i%3} {50 + (i % 60)}.5"
+        for i in range(64)
+    ]
+    payload = ("\n".join(lines) + "\n").encode()
+
+    def serve(srv):
+        conn, _ = srv.accept()
+        # two sends with a gap: exercises block re-assembly mid-stream
+        conn.sendall(payload[: len(payload) // 2])
+        import time as _t
+
+        _t.sleep(0.05)
+        conn.sendall(payload[len(payload) // 2 :])
+        conn.close()
+        srv.close()
+
+    def run(raw):
+        # bind FIRST, then hand the listening socket to the server
+        # thread — no rebind race, and the source always finds a listener
+        srv = socket.create_server(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        t = threading.Thread(target=serve, args=(srv,), daemon=True)
+        t.start()
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=16, max_batch_delay_ms=100.0)
+        )
+        text = env.socket_text_stream("127.0.0.1", port, raw=raw)
+        handle = build_ch1(env, text).collect()
+        env.execute("ch1-socket")
+        t.join(timeout=10)
+        return handle.items
+
+    want = run(raw=False)
+    got = run(raw=True)
+    assert want  # alerts actually flowed
+    assert got == want
+
+
 def test_raw_resume_skips_consumed_lines(tmp_path):
     lines = [
         f"1563452051 10.8.22.{i%2} cpu0 {91 + (i % 5)}.5" for i in range(40)

@@ -59,12 +59,18 @@ class StreamExecutionEnvironment:
         self._checkpoint_restore_path = path
 
     # -- sources -------------------------------------------------------------
-    def socket_text_stream(self, host: str, port: int) -> DataStream:
+    def socket_text_stream(
+        self, host: str, port: int, raw: bool = False
+    ) -> DataStream:
         """nc-compatible line source (reference chapter1/.../Main.java:17,
-        run with ``nc -lk 8080`` per chapter1/README.md:65-68)."""
+        run with ``nc -lk 8080`` per chapter1/README.md:65-68).
+
+        ``raw=True`` streams byte blocks into the native parse lane (no
+        per-line Python objects) — the high-rate ingest mode; arrival
+        stamps coarsen to the receiving ``recv``'s wall clock."""
         from ..runtime.sources import SocketTextSource
 
-        return self.add_source(SocketTextSource(host, port))
+        return self.add_source(SocketTextSource(host, port, raw=raw))
 
     socketTextStream = socket_text_stream
 
