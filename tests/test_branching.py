@@ -1,0 +1,94 @@
+"""Stream fan-out: several sinks off one stream, each with its own
+map/filter tail (Flink's everyday stream-reuse pattern). The shared
+prefix compiles into ONE device program; branch tails run host-side over
+the compacted emissions.
+"""
+
+import pytest
+
+from tpustream import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple2,
+    Tuple3,
+)
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+
+def parse(value: str) -> Tuple3:
+    items = value.split(" ")
+    return Tuple3(items[1], items[2], float(items[3]))
+
+
+LINES = [
+    "1 10.8.22.1 cpu0 95.5",
+    "2 10.8.22.2 cpu1 50.0",
+    "3 10.8.22.1 cpu0 99.9",
+    "4 10.8.22.3 cpu2 91.0",
+    "5 10.8.22.2 cpu1 10.0",
+]
+
+
+def test_two_filter_branches_one_stateless_stream():
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=2))
+    parsed = env.add_source(ReplaySource(LINES)).map(parse)
+    crit = parsed.filter(lambda t: t.f2 > 99).collect()
+    warn = parsed.filter(lambda t: t.f2 > 90).map(
+        lambda t: Tuple2(t.f0, t.f2)
+    ).collect()
+    env.execute("fanout")
+    assert crit.items == [("10.8.22.1", "cpu0", 99.9)]
+    assert warn.items == [
+        ("10.8.22.1", 95.5),
+        ("10.8.22.1", 99.9),
+        ("10.8.22.3", 91.0),
+    ]
+
+
+def test_branch_after_windowed_aggregate():
+    class Ts(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(1000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0]) * 10_000
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    summed = (
+        env.add_source(ReplaySource(LINES))
+        .assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .reduce(lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2))
+    )
+    everything = summed.collect()
+    high = summed.filter(lambda t: t.f2 > 90).collect()
+    env.execute("fanout-window")
+    assert sorted(tuple(t) for t in everything.items) == [
+        ("10.8.22.1", "cpu0", 95.5),
+        ("10.8.22.1", "cpu0", 99.9),
+        ("10.8.22.2", "cpu1", 10.0),
+        ("10.8.22.2", "cpu1", 50.0),
+        ("10.8.22.3", "cpu2", 91.0),
+    ]
+    assert sorted(tuple(t) for t in high.items) == [
+        ("10.8.22.1", "cpu0", 95.5),
+        ("10.8.22.1", "cpu0", 99.9),
+        ("10.8.22.3", "cpu2", 91.0),
+    ]
+
+
+def test_branch_point_cannot_split_keyed_work():
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=2))
+    parsed = env.add_source(ReplaySource(LINES)).map(parse)
+    parsed.collect()
+    parsed.key_by(0).max(2).collect()
+    with pytest.raises(NotImplementedError, match="branch"):
+        env.execute("bad-fanout")

@@ -252,6 +252,58 @@ def test_session_window_resume(tmp_path):
     ]
 
 
+def test_session_process_resume(tmp_path):
+    """Session + ProcessWindowFunction: element buffers, cell min/max,
+    AND the deferred pending_clear mask survive snapshots — a checkpoint
+    taken right after a firing step must not re-emit the fired session
+    (its cells are still in state, cleared only at the next step)."""
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        Time,
+        Tuple2,
+    )
+    from tpustream.api.windows import EventTimeSessionWindows
+
+    class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(2_000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    def median(key, ctx, elements, out):
+        vals = sorted(e.f1 for e in elements)
+        m = (
+            float(vals[len(vals) // 2])
+            if len(vals) % 2
+            else (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2
+        )
+        out.collect(Tuple2(key, m))
+
+    def build(env, text):
+        return (
+            text.assign_timestamps_and_watermarks(TsExtractor())
+            .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .window(EventTimeSessionWindows.with_gap(Time.milliseconds(10_000)))
+            .process(median)
+        )
+
+    lines = [
+        "1000 a 1", "4000 a 3", "5000 b 16", "9000 a 5",
+        "25000 a 8",   # closes a's first session (median 3) and b's (16)
+        "27000 b 32",
+        "45000 a 64",  # closes the 25000/27000 sessions
+    ]
+    full = resume_suffix_check(
+        build, lines, tmp_path, time_char=TimeCharacteristic.EventTime,
+        key_capacity=64, alert_capacity=1024,
+    )
+    assert sorted((t.f0, t.f1) for t in full) == [
+        ("a", 3.0), ("a", 8.0), ("a", 64.0), ("b", 16.0), ("b", 32.0),
+    ]
+
+
 def test_count_window_resume(tmp_path):
     """Per-key (acc, cnt) count-window state resumes mid-window."""
     from tpustream import Tuple2

@@ -194,6 +194,21 @@ class HostStage:
         )
 
 
+def _bind_ops(ops):
+    """Pre-resolve (op, fn) pairs to callables for per-record replay."""
+    return [(op, as_callable(fn, op)) for op, fn in ops]
+
+
+def _apply_ops(bound_ops, item):
+    """Run a map/filter tail over one record; (item, kept)."""
+    for op, fn in bound_ops:
+        if op == "map":
+            item = fn(item)
+        elif not fn(item):
+            return item, False
+    return item, True
+
+
 class JobResult:
     def __init__(self, metrics: Metrics):
         self.metrics = metrics
@@ -204,24 +219,25 @@ class JobResult:
 
 def _make_sinks(plan: JobPlan, cfg: StreamConfig):
     pp = cfg.print_parallelism if cfg.print_parallelism is not None else cfg.parallelism
-    sinks = []
-    for node in plan.sink_nodes:
+
+    def build_sink(node):
         if node.op == "sink_print":
-            sinks.append(PrintSink(parallelism=pp))
-        elif node.op == "sink_collect":
-            sinks.append(CollectSink(node.params["handle"]))
-        else:
-            sinks.append(FnSink(node.params["fn"]))
+            return PrintSink(parallelism=pp)
+        if node.op == "sink_collect":
+            return CollectSink(node.params["handle"])
+        return FnSink(node.params["fn"])
+
+    # (host-side branch ops, sink) per main branch — ops run over the
+    # compacted emissions (alert-scale), mirroring the reference's
+    # stream fan-out where several consumers share one upstream.
+    # Callables pre-bind here, off the per-record path.
+    sinks = [
+        (_bind_ops(branch.ops), build_sink(branch.sink_node))
+        for branch in plan.branches
+    ]
     side = {}
     for so in plan.side_outputs:
-        node = so.sink_node
-        if node.op == "sink_print":
-            s = PrintSink(parallelism=pp)
-        elif node.op == "sink_collect":
-            s = CollectSink(node.params["handle"])
-        else:
-            s = FnSink(node.params["fn"])
-        side[so.tag.id] = (so, s)
+        side[so.tag.id] = (_bind_ops(so.ops), build_sink(so.sink_node))
     return sinks, side
 
 
@@ -550,16 +566,20 @@ class Runner:
             if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 
+    def _emit_row(self, row, subtask):
+        """Fan one emitted record out to every branch: apply the
+        branch's host-side map/filter tail, then its sink."""
+        for ops, sink in self.sinks:
+            item, keep = _apply_ops(ops, row)
+            if keep:
+                sink.emit(item, subtask=subtask)
+
     def _dispatch(self, emissions, t_batch=None):
         emitted_before = self.metrics.records_emitted
         fire_info = emissions.get("process_fire")
         if fire_info is not None:
-            def emit(item, subtask):
-                for sink in self.sinks:
-                    sink.emit(item, subtask=subtask)
-
             n, fired = self.program.evaluate_fires(
-                self.state, fire_info, self.plan.device_post, emit
+                self.state, fire_info, self.plan.device_post, self._emit_row
             )
             self.metrics.records_emitted += n
             self.metrics.window_fires += fired
@@ -581,8 +601,7 @@ class Runner:
                 subtask = np.asarray(subtask)[sel] if subtask is not None else None
                 for j, row in enumerate(self.formatter.rows(cols)):
                     st = int(subtask[j]) if subtask is not None else None
-                    for sink in self.sinks:
-                        sink.emit(row, subtask=st)
+                    self._emit_row(row, st)
                 self.metrics.records_emitted += sel.size
         late = emissions.get("late")
         if late is not None and self.side_sinks:
@@ -604,16 +623,11 @@ class Runner:
         fmt = EmissionFormatter(
             self.program.mid_kinds, self.program.mid_tables
         )
-        for so, sink in self.side_sinks.values():
+        for ops, sink in self.side_sinks.values():
             for row in fmt.rows(cols):
-                keep = True
-                for op, fn in so.ops:
-                    if op == "map":
-                        row = as_callable(fn, "map")(row)
-                    else:
-                        keep = keep and bool(as_callable(fn, "filter")(row))
+                item, keep = _apply_ops(ops, row)
                 if keep:
-                    sink.emit(row)
+                    sink.emit(item)
 
 
 def execute_job(env, sink_nodes) -> JobResult:

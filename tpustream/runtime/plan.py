@@ -57,6 +57,17 @@ class SideOutputPlan:
 
 
 @dataclass
+class BranchPlan:
+    """One main-sink branch: host-side (op, fn) suffix past the shared
+    compiled chain, then its sink. Branch fan-out (several sinks off one
+    stream, each with its own map/filter tail) runs over the compacted
+    emissions, so per-record host work is alert-scale, not input-scale."""
+
+    ops: List[tuple]
+    sink_node: Node
+
+
+@dataclass
 class JobPlan:
     source: Any
     host_ops: List[HostOp]
@@ -70,7 +81,7 @@ class JobPlan:
     key_pos: Optional[int]
     stateful: Optional[StatefulSpec]
     device_post: List[tuple]             # (op, fn) after the stateful op
-    sink_nodes: List[Node]
+    branches: List[BranchPlan]
     side_outputs: List[SideOutputPlan]
     time_characteristic: TimeCharacteristic
 
@@ -91,14 +102,52 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
             main_sinks.append(s)
     if not main_sinks:
         raise RuntimeError("a job needs at least one main (non-side-output) sink")
-    first_chain = main_sinks[0].chain_to_source()[:-1]
-    for s in main_sinks[1:]:
-        if s.chain_to_source()[:-1] != first_chain:
-            raise NotImplementedError(
-                "multiple sinks are only supported on the same upstream stream"
-            )
 
-    nodes = main_sinks[0].chain_to_source()
+    # Branch fan-out: the longest common prefix of every main sink's
+    # chain compiles into the device program; each branch's suffix must
+    # be map/filter only and runs host-side over the emissions (Flink's
+    # stream reuse — one stream, several consumers with their own tails)
+    chains = [s.chain_to_source() for s in main_sinks]
+    prefix_len = len(chains[0])
+    for chain in chains[1:]:
+        common = 0
+        for a, b in zip(chains[0], chain):
+            if a is not b:
+                break
+            common += 1
+        prefix_len = min(prefix_len, common)
+    # never include any sink node in the shared prefix
+    prefix_len = min(
+        prefix_len,
+        next(
+            (
+                i
+                for i, n in enumerate(chains[0])
+                if n.op.startswith("sink_")
+            ),
+            prefix_len,
+        ),
+    )
+    if prefix_len == 0 or chains[0][0].op != "source":
+        raise NotImplementedError(
+            "all sinks of a job must consume streams built from ONE "
+            "source; run unrelated pipelines as separate jobs"
+        )
+    branches: List[BranchPlan] = []
+    for s, chain in zip(main_sinks, chains):
+        ops: List[tuple] = []
+        for n in chain[prefix_len:-1]:
+            if n.op in ("map", "filter"):
+                ops.append((n.op, n.params["fn"]))
+            else:
+                raise NotImplementedError(
+                    f"branched streams support map/filter tails only; "
+                    f"operator {n.op} must come before the branch point "
+                    f"(keyed/windowed work belongs to the shared stream)"
+                )
+        branches.append(BranchPlan(ops=ops, sink_node=s))
+
+    nodes = chains[0][:prefix_len]
     assert nodes[0].op == "source"
     source = nodes[0].params["source"]
 
@@ -240,7 +289,7 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
         key_pos=key_pos,
         stateful=stateful,
         device_post=device_post,
-        sink_nodes=main_sinks,
+        branches=branches,
         side_outputs=side_outputs,
         time_characteristic=env.time_characteristic,
     )
