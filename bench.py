@@ -472,6 +472,7 @@ def main():
                     KINDS, compact,
                     rolling_kind="max", rolling_pos=2, key_col=0,
                     key_emit=lambda s: s.astype(jnp.int32),
+                    sentinel_leaf=1,
                 )
                 return (rstate, tot + emis[2].sum(), i + 1), None
 
@@ -481,7 +482,7 @@ def main():
             return rstate, tot, i
 
         rmulti_j = jax.jit(rmulti, donate_argnums=0)
-        rstate = R.init_rolling_state(K, KINDS, compact)
+        rstate = R.init_rolling_state(K, KINDS, compact, sentinel_leaf=1)
         rtot = jnp.asarray(0.0, jnp.float64)
         ri = jnp.asarray(0, jnp.int64)
         # warm past the coupon-collector horizon (~K ln K = 14.5M events)

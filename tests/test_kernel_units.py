@@ -277,6 +277,54 @@ def test_rolling_commutative_fast_path_matches_oracle(
             )
 
 
+@pytest.mark.parametrize("kind", ["max", "min", "sum"])
+def test_rolling_fast_path_sentinel_occupancy_matches_oracle(kind):
+    """sentinel_leaf derives `seen` from a keep-first STR plane
+    initialized to -1 (interned ids are >= 0) — must be exact through
+    new-key and steady-state batches, with the seen plane untouched."""
+    rng = np.random.default_rng(11)
+    kinds = ["str", "str", "f64"]
+    kcap, b, pos = 13, 96, 2
+    combine = make_combiner(kind, pos)
+    state = init_rolling_state(kcap, kinds, sentinel_leaf=1)
+
+    batches = []
+    for it in range(5):
+        hi = kcap if it < 2 else 4
+        keys = rng.integers(0, hi, b).astype(np.int32)
+        c0 = keys.copy()
+        c1 = rng.integers(0, 50, b).astype(np.int32)  # interned ids >= 0
+        c2 = np.round(rng.random(b) * 100, 1).astype(np.float64)
+        valid = rng.random(b) < 0.9
+        batches.append((keys, (c0, c1, c2), valid))
+
+    want = _rolling_reference(kind, pos, batches, 3)
+    for (keys, cols, valid), w in zip(batches, want):
+        state, emis_sorted, sv, sk, inv = rolling_step(
+            state,
+            jnp.asarray(keys),
+            tuple(jnp.asarray(c) for c in cols),
+            jnp.asarray(valid),
+            combine,
+            kinds,
+            rolling_kind=kind,
+            rolling_pos=pos,
+            key_col=0,
+            key_emit=lambda s: s.astype(jnp.int32),
+            sentinel_leaf=1,
+        )
+        inv = np.asarray(inv)
+        for c in range(3):
+            arrival = np.asarray(emis_sorted[c])[inv]
+            np.testing.assert_allclose(
+                arrival[valid].astype(np.float64),
+                w[c][valid].astype(np.float64),
+                rtol=1e-6,
+            )
+    # the dedicated seen plane stays cold on the sentinel path
+    assert not np.asarray(state["seen"]).any()
+
+
 # ------------------------------------------------------------- sessions ----
 
 def test_session_runs_link_and_fire_propagation():

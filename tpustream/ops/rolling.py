@@ -42,13 +42,29 @@ def init_rolling_state(
     key_capacity: int,
     kinds: List[str],
     compact32: Union[bool, Sequence[bool]] = False,
+    sentinel_leaf: int = None,
 ) -> dict:
+    """``sentinel_leaf`` (commutative fast path only) names a keep-first
+    STR leaf whose plane doubles as the occupancy test: interned ids are
+    >= 0, so initializing it to -1 lets the step derive ``seen`` from a
+    plane it gathers anyway — the dedicated seen plane then costs
+    nothing on the hot path (one fewer [B]-gather per batch and one
+    fewer scatter per new-key batch)."""
+    planes = [
+        jnp.zeros((key_capacity,), dtype=dt)
+        for dt in plane_dtypes(kinds, compact32)
+    ]
+    if sentinel_leaf is not None:
+        if kinds[sentinel_leaf] != "str":
+            raise ValueError(
+                f"sentinel_leaf must name a STR leaf (interned ids >= 0); "
+                f"leaf {sentinel_leaf} is {kinds[sentinel_leaf]!r}"
+            )
+        sl = leaf_plane_slices(kinds, compact32)[sentinel_leaf]
+        planes[sl.start] = jnp.full((key_capacity,), -1, dtype=jnp.int32)
     return {
         "seen": jnp.zeros((key_capacity,), dtype=bool),
-        "planes": [
-            jnp.zeros((key_capacity,), dtype=dt)
-            for dt in plane_dtypes(kinds, compact32)
-        ],
+        "planes": planes,
     }
 
 
@@ -102,6 +118,7 @@ def rolling_step(
     rolling_pos: int = None,
     key_col: int = None,
     key_emit: Callable = None,
+    sentinel_leaf: int = None,
 ) -> Tuple[dict, Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batch through a rolling aggregate.
 
@@ -126,7 +143,7 @@ def rolling_step(
     if rolling_kind in ("max", "min", "sum"):
         return _rolling_step_commutative(
             state, keys, cols, valid, kinds, compact32,
-            rolling_kind, rolling_pos, key_col, key_emit,
+            rolling_kind, rolling_pos, key_col, key_emit, sentinel_leaf,
         )
     K = state["seen"].shape[0]
     perm, sk, sv, seg_starts = sort_by_key(keys, valid, max_key=K)
@@ -168,7 +185,8 @@ _REDUCERS = {
 
 
 def _rolling_step_commutative(
-    state, keys, cols, valid, kinds, compact32, kind, pos, key_col, key_emit
+    state, keys, cols, valid, kinds, compact32, kind, pos, key_col, key_emit,
+    sentinel_leaf=None,
 ):
     """Fast path for max/min/sum field aggregates (see rolling_step)."""
     K = state["seen"].shape[0]
@@ -177,6 +195,11 @@ def _rolling_step_commutative(
     c32 = _per_leaf(compact32, kinds)
     if key_col is not None and (key_emit is None or key_col == pos):
         key_col = None  # aggregating the keyed column: not key-invariant
+    if sentinel_leaf is not None and (
+        kinds[sentinel_leaf] != "str"
+        or sentinel_leaf in (pos, key_col)
+    ):
+        sentinel_leaf = None
 
     perm, sk, sv, seg_starts = sort_by_key(keys, valid, max_key=K)
     safe_keys = jnp.where(sv, sk, 0).astype(jnp.int32)
@@ -192,12 +215,20 @@ def _rolling_step_commutative(
         ]
         return unpack_words(words, [kinds[i]], [c32[i]])[0]
 
+    keep = [i for i in range(len(kinds)) if i != pos and i != key_col]
+    stored_keep = [gather_leaf(i) for i in keep]
+
     # aggregated column: within-batch inclusive per-key prefix
     agg_sorted = cols[pos][perm]
     (agg_prefix,) = segmented_scan(
         (agg_sorted,), seg_starts, lambda a, b: (reducer(a[0], b[0]),)
     )
-    seen_sorted = state["seen"][safe_keys] & sv
+    if sentinel_leaf is not None:
+        # occupancy from the sentinel keep leaf (gathered anyway):
+        # interned ids are >= 0, -1 marks a never-written key row
+        seen_sorted = (stored_keep[keep.index(sentinel_leaf)] >= 0) & sv
+    else:
+        seen_sorted = state["seen"][safe_keys] & sv
     stored_agg = gather_leaf(pos)
     combined_agg = reducer(stored_agg, agg_prefix)
     emis_agg = jnp.where(seen_sorted, combined_agg, agg_prefix)
@@ -210,8 +241,6 @@ def _rolling_step_commutative(
             w.astype(state["planes"][p].dtype), mode="drop", unique_indices=True
         )
 
-    keep = [i for i in range(len(kinds)) if i != pos and i != key_col]
-    stored_keep = [gather_leaf(i) for i in keep]
     any_new = jnp.any(sv & ~seen_sorted)
 
     # keep-first leaves + seen only change when the batch contains a key
@@ -244,7 +273,13 @@ def _rolling_step_commutative(
                     w.astype(p.dtype), mode="drop", unique_indices=True
                 )
                 flat += 1
-        new_seen = seen.at[new_idx].set(True, mode="drop", unique_indices=True)
+        if sentinel_leaf is not None:
+            # the sentinel plane's keep-first write IS the seen marker
+            new_seen = seen
+        else:
+            new_seen = seen.at[new_idx].set(
+                True, mode="drop", unique_indices=True
+            )
         return tuple(out_emis), tuple(out_planes), new_seen
 
     def no_new(keep_planes, seen):

@@ -37,7 +37,10 @@ import numpy as np
 # meta records parallelism because the sharded key layout is shard-major
 # v4: stateless state is a real alert_overflow counter (device-compacted
 # emissions); session process() programs add cell_min/max/pending_clear
-FORMAT_VERSION = 4
+# v5: commutative rolling state derives occupancy from a -1-initialized
+# sentinel STR plane — a v4 snapshot's zero-initialized plane would read
+# every key row as already-seen
+FORMAT_VERSION = 5
 _META_KEY = "__meta__"
 
 

@@ -189,9 +189,23 @@ class RollingProgram(BaseProgram):
             return [i == st.rolling_pos for i in range(len(self.mid_kinds))]
         return False
 
+    @property
+    def _sentinel_leaf(self):
+        """Keep-first STR leaf whose plane doubles as occupancy for the
+        commutative fast path (interned ids >= 0; -1 marks unseen) —
+        saves the dedicated seen-plane gather on every batch."""
+        st = self.plan.stateful
+        if st.kind != "rolling" or st.rolling_kind not in ("max", "min", "sum"):
+            return None
+        for i, kd in enumerate(self.mid_kinds):
+            if kd == STR and i != st.rolling_pos and i != self.key_pos:
+                return i
+        return None
+
     def init_state(self):
         return rolling_ops.init_rolling_state(
-            self.cfg.key_capacity, self.mid_kinds, self._compact32
+            self.cfg.key_capacity, self.mid_kinds, self._compact32,
+            sentinel_leaf=self._sentinel_leaf,
         )
 
     def state_specs(self, state):
@@ -213,7 +227,8 @@ class RollingProgram(BaseProgram):
         fast_kwargs = {}
         if st.kind == "rolling":
             fast_kwargs = dict(
-                rolling_kind=st.rolling_kind, rolling_pos=st.rolling_pos
+                rolling_kind=st.rolling_kind, rolling_pos=st.rolling_pos,
+                sentinel_leaf=self._sentinel_leaf,
             )
             key_kind = self.mid_kinds[self.key_pos]
             if self.key_pos != st.rolling_pos and key_kind in (STR, I64):
