@@ -1,0 +1,63 @@
+"""H2D delta compression: lossless int64 packing and its demotion path.
+
+The executor ships int64 columns/timestamps as int32 deltas against a
+per-batch base (StreamConfig.h2d_compress); a batch whose valid-row span
+exceeds int32 must demote that column to raw PERMANENTLY — rebuilding
+the jitted step mid-stream — with bit-exact results either way.
+"""
+
+import numpy as np
+
+from tpustream import StreamExecutionEnvironment, Tuple2
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+
+def parse(line: str) -> Tuple2:
+    items = line.split(" ")
+    return Tuple2(items[1], int(items[2]))
+
+
+def run(lines, **cfg):
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=4, **cfg))
+    text = env.add_source(ReplaySource(lines))
+    handle = (
+        text.map(parse)
+        .key_by(0)
+        .sum(1)
+        .collect()
+    )
+    env.execute("h2d")
+    return [tuple(t) for t in handle.items]
+
+
+def test_mid_stream_span_overflow_demotes_exactly():
+    # batch 1 fits int32 deltas; batch 2 spans > 2^31 (and includes
+    # negatives); batch 3 returns to small values — all on the SAME
+    # demoted column, exercising the one-time step rebuild
+    big = 3 << 31
+    lines = (
+        ["1 a 5", "1 b 7", "1 a 11", "1 b 13"]
+        + [f"1 a {big}", f"1 b {-big}", "1 a 17", "1 b 19"]
+        + ["1 a 23", "1 b 29", "1 a 31", "1 b 37"]
+    )
+    got = run(lines)
+    want = run(lines, h2d_compress=False)
+    assert got == want
+    # the rolling sums are exact through the demotion
+    totals = {}
+    expect = []
+    for line in lines:
+        _, k, v = line.split(" ")
+        totals[k] = totals.get(k, 0) + int(v)
+        expect.append((k, totals[k]))
+    assert got == expect
+
+
+def test_full_range_column_never_compresses():
+    # min near -2^62 and max near 2^62 in ONE batch: the span check must
+    # not wrap (it is computed in Python ints) and the column ships raw
+    lo, hi = -(2**62), 2**62
+    lines = [f"1 a {lo}", f"1 a {hi}", "1 a 1", "1 a 2"]
+    got = run(lines)
+    assert got[-1] == ("a", lo + hi + 1 + 2)
