@@ -60,4 +60,5 @@ def test_full_range_column_never_compresses():
     lo, hi = -(2**62), 2**62
     lines = [f"1 a {lo}", f"1 a {hi}", "1 a 1", "1 a 2"]
     got = run(lines)
+    assert got == run(lines, h2d_compress=False)
     assert got[-1] == ("a", lo + hi + 1 + 2)
