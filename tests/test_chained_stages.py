@@ -1,0 +1,138 @@
+"""Chained keyed stages: re-keying after a stateful operator.
+
+Classic two-stage aggregation — per-key windows, then a cross-key rollup
+keyed by a different field — runs as two compiled device programs, the
+second fed by the first's compacted emissions (build_plan_chain /
+Runner.pump_chain). Stage-2 time semantics are processing time (upstream
+emissions carry no event timestamps).
+"""
+
+import pytest
+
+from tpustream import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple3,
+)
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+
+class Ts(BoundedOutOfOrdernessTimestampExtractor):
+    def __init__(self):
+        super().__init__(Time.milliseconds(1000))
+
+    def extract_timestamp(self, value):
+        return int(value.split(" ")[0])
+
+
+def parse(line: str) -> Tuple3:
+    items = line.split(" ")
+    return Tuple3(items[1], items[2], int(items[3]))
+
+
+LINES = [
+    "1000 a x 5",
+    "2000 b y 7",
+    "5000 a x 3",
+    "12000 a y 4",   # watermark 11000: fires [0,10s): (a,x,8), (b,y,7)
+    "25000 b x 9",   # watermark 24000: fires [10s,20s): (a,y,4)
+    #                  EOS fires [20s,30s): (b,x,9)
+]
+
+
+def _build_two_stage(env, rolling_kind="max"):
+    text = env.add_source(ReplaySource(LINES))
+    stage1 = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+    )
+    return getattr(stage1.key_by(1), rolling_kind)(2)
+
+
+def test_window_then_rekeyed_rolling_max():
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    handle = _build_two_stage(env).collect()
+    env.execute("two-stage")
+    # stage 2 sees, in order: (a,x,8), (b,y,7), (a,y,4), (b,x,9);
+    # rolling max keyed by cpu with Flink's stale-field semantics
+    assert [tuple(t) for t in handle.items] == [
+        ("a", "x", 8),
+        ("b", "y", 7),
+        ("b", "y", 7),   # 4 does not beat 7; stored record re-emitted
+        ("a", "x", 9),   # 9 beats 8; non-aggregated fields keep (a,x)
+    ]
+
+
+def test_window_then_rekeyed_processing_time_window():
+    """Stage 2 as an explicit PROCESSING-time window
+    (TumblingProcessingTimeWindows under an event-time env): stage-1
+    results re-aggregate per cpu, and end-of-stream fires the remaining
+    stage-2 windows (Flink's end-of-input MAX watermark)."""
+    from tpustream.api.windows import TumblingProcessingTimeWindows
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(LINES))
+    handle = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+        .key_by(1)
+        .window(TumblingProcessingTimeWindows.of(Time.minutes(5)))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+        .collect()
+    )
+    env.execute("two-stage-window")
+    # stage 2 input: (a,x,8), (b,y,7), (a,y,4), (b,x,9) — all within one
+    # 5-minute processing-time window per cpu, fired at end of stream
+    assert sorted(tuple(t) for t in handle.items) == [
+        ("a", "x", 17),   # 8 + 9, first record's fields kept
+        ("b", "y", 11),   # 7 + 4
+    ]
+
+
+def test_chained_stage_rejects_event_time_windows():
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(LINES))
+    (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+        .key_by(1)
+        .time_window(Time.seconds(10))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+        .collect()
+    )
+    with pytest.raises(NotImplementedError, match="PROCESSING time"):
+        env.execute("two-stage-event-window")
+
+
+def test_chained_stage_rejects_parallelism_and_checkpoints(tmp_path):
+    for cfg in (
+        StreamConfig(batch_size=4, parallelism=2, key_capacity=16),
+        StreamConfig(batch_size=4, checkpoint_dir=str(tmp_path),
+                     checkpoint_interval_batches=1, key_capacity=16),
+    ):
+        env = StreamExecutionEnvironment(cfg)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        _build_two_stage(env).collect()
+        with pytest.raises(NotImplementedError, match="chain"):
+            env.execute("two-stage-restricted")

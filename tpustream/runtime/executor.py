@@ -28,7 +28,7 @@ from ..hostparse import PlanEvaluator, run_fallback_map
 from ..records import STR, Batch, Column, StringTable
 from ..api.timeapi import TimeCharacteristic
 from .metrics import Metrics, Stopwatch
-from .plan import JobPlan, build_plan
+from .plan import JobPlan, build_plan_chain
 from .sinks import CollectSink, EmissionFormatter, FnSink, PrintSink
 from .sources import SourceBatch
 from .step import LONG_MIN, build_program
@@ -274,6 +274,11 @@ class Runner:
         depth = 1 if self.program.emissions_reference_state else cfg.async_depth
         self._max_inflight = max(0, depth - 1)
         self._inflight: List[tuple] = []
+        # chained stages: emissions feed the downstream runner as
+        # columnar batches instead of the sinks (build_plan_chain)
+        self.downstream: Optional["Runner"] = None
+        self._chain_buf: List[list] = []
+        self.count_input = True
         # device counter values restored from a checkpoint (finalize
         # subtracts them so a resumed run reports since-resume numbers
         # and strict_overflow never fails on pre-snapshot loss)
@@ -388,7 +393,8 @@ class Runner:
                 padded, self.plan.time_characteristic
             )
             self._run_step(inputs, wm_lower, t_batch)
-            self.metrics.records_in += int(sub.n)
+            if self.count_input:
+                self.metrics.records_in += int(sub.n)
             # with a max_fires_per_step budget, drain deferred window ends
             # BEFORE the next batch can advance the pane ring past them —
             # each drain step still fires at most `budget` ends, so the
@@ -472,6 +478,42 @@ class Runner:
         end of stream)."""
         while self._inflight:
             self._finish(*self._inflight.pop(0))
+
+    def chain_to(self, downstream: "Runner"):
+        self.downstream = downstream
+        downstream.count_input = False
+
+    def pump_chain(self, proc_now: int):
+        """Move buffered emissions to the downstream runner (or tick its
+        processing-time clock when there are none), then cascade."""
+        d = self.downstream
+        if d is None:
+            return
+        if self._chain_buf:
+            bufs, self._chain_buf = self._chain_buf, []
+            cols = [
+                np.concatenate([b[i] for b in bufs])
+                for i in range(len(bufs[0]))
+            ]
+            n = len(cols[0])
+            columns = [
+                Column(k, c, t)
+                for k, c, t in zip(
+                    self.program.out_kinds, cols, self.program.out_tables
+                )
+            ]
+            batch = Batch(
+                n, columns, ts=None,
+                proc_ts=np.full(n, proc_now, dtype=np.int64),
+            )
+            d.feed(batch, proc_now - 1)
+            d._last_tick = proc_now
+        elif getattr(d, "_last_tick", None) != proc_now:
+            # clock tick, at most once per distinct proc_now: an empty
+            # flush step per source batch would double device launches
+            d.flush(proc_now - 1)
+            d._last_tick = proc_now
+        d.pump_chain(proc_now)
 
     def _finish(self, emissions, counts, t_batch):
         # the blocking waits live here, not in _run_step (dispatch is
@@ -597,12 +639,19 @@ class Runner:
                 sel = np.nonzero(mask)[0]
             if sel.size:
                 cols = [np.asarray(c)[sel] for c in main["cols"]]
-                subtask = main.get("subtask")
-                subtask = np.asarray(subtask)[sel] if subtask is not None else None
-                for j, row in enumerate(self.formatter.rows(cols)):
-                    st = int(subtask[j]) if subtask is not None else None
-                    self._emit_row(row, st)
-                self.metrics.records_emitted += sel.size
+                if self.downstream is not None:
+                    # chained stage: hand the columnar emissions straight
+                    # to the next runner (no Python rows in between)
+                    self._chain_buf.append(cols)
+                else:
+                    subtask = main.get("subtask")
+                    subtask = (
+                        np.asarray(subtask)[sel] if subtask is not None else None
+                    )
+                    for j, row in enumerate(self.formatter.rows(cols)):
+                        st = int(subtask[j]) if subtask is not None else None
+                        self._emit_row(row, st)
+                    self.metrics.records_emitted += sel.size
         late = emissions.get("late")
         if late is not None and self.side_sinks:
             self._dispatch_late(late)
@@ -630,9 +679,42 @@ class Runner:
                     sink.emit(item)
 
 
+def _make_runner_chain(plans, cfg, metrics) -> Runner:
+    """Build the runner for plans[0] plus downstream runners for any
+    chained stages, wiring record schemas from each upstream program."""
+    runner = Runner(plans[0], cfg, metrics)
+    up = runner
+    for p2 in plans[1:]:
+        p2.record_kinds.extend(up.program.out_kinds)
+        p2.tables.extend(up.program.out_tables)
+        r2 = Runner(p2, cfg, metrics)
+        up.chain_to(r2)
+        up = r2
+    return runner
+
+
 def execute_job(env, sink_nodes) -> JobResult:
     cfg = env.config
-    plan = build_plan(env, sink_nodes)
+    plans = build_plan_chain(env, sink_nodes)
+    plan = plans[0]
+    chained = len(plans) > 1
+    if chained:
+        if cfg.parallelism > 1:
+            raise NotImplementedError(
+                "chained keyed stages run single-chip for now "
+                "(parallelism must be 1)"
+            )
+        if cfg.checkpoint_dir:
+            raise NotImplementedError(
+                "checkpointing across chained keyed stages is not "
+                "supported yet"
+            )
+        for p in plans[:-1]:
+            if p.stateful is not None and p.stateful.apply_kind == "process":
+                raise NotImplementedError(
+                    "chaining after a full-window process() stage is not "
+                    "supported (its emissions are host-evaluated rows)"
+                )
     host = HostStage(plan, cfg)
     metrics = Metrics()
     runner: Optional[Runner] = None
@@ -710,7 +792,7 @@ def execute_job(env, sink_nodes) -> JobResult:
             proc_now = max(proc_now, int(sb.advance_proc_to))
         if batch is not None:
             if runner is None:
-                runner = Runner(plan, cfg, metrics)
+                runner = _make_runner_chain(plans, cfg, metrics)
             runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
         elif (
             sb.advance_proc_to is not None
@@ -718,6 +800,8 @@ def execute_job(env, sink_nodes) -> JobResult:
             and domain == TimeCharacteristic.ProcessingTime
         ):
             runner.flush(proc_now - 1)
+        if runner is not None:
+            runner.pump_chain(proc_now)
         if (
             ckpt_enabled
             and runner is not None
@@ -751,11 +835,23 @@ def execute_job(env, sink_nodes) -> JobResult:
             runner.flush(proc_now - 1)
         else:
             runner.flush(MAX_WATERMARK)
-
-    if runner is not None:
         runner.drain_inflight()
-        runner.finalize_metrics()
-        runner.check_strict()
+        # chained stages: push the final emissions down the chain, then
+        # fire EVERYTHING still windowed (Flink's end-of-input MAX
+        # watermark) — the chain's processing-time stamps are synthetic
+        # arrival times, and nothing more can arrive after EOS
+        r = runner
+        while r.downstream is not None:
+            r.pump_chain(proc_now)
+            d = r.downstream
+            d.flush(MAX_WATERMARK)
+            d.drain_inflight()
+            r = d
+        r = runner
+        while r is not None:
+            r.finalize_metrics()
+            r.check_strict()
+            r = r.downstream
 
     env.metrics = metrics
     return JobResult(metrics)

@@ -84,6 +84,10 @@ class JobPlan:
     branches: List[BranchPlan]
     side_outputs: List[SideOutputPlan]
     time_characteristic: TimeCharacteristic
+    # a second keyed stage (key_by after a stateful op) splits the chain:
+    # these nodes form the NEXT stage's plan, fed by this stage's
+    # compacted emissions (see build_plan_chain)
+    chain_rest: List[Node] = field(default_factory=list)
 
 
 def _is_raw_stage(kinds: Optional[List[str]]) -> bool:
@@ -163,6 +167,7 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
     key_pos: Optional[int] = None
     stateful: Optional[StatefulSpec] = None
     pending_window: Optional[Node] = None
+    chain_rest: List[Node] = []
 
     for node in nodes[1:]:
         op = node.op
@@ -208,7 +213,10 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
             continue
         if op == "key_by":
             if stateful is not None:
-                raise NotImplementedError("re-keying after a stateful operator")
+                # chain split: everything from this key_by on becomes the
+                # next stage, fed by this stage's emissions
+                chain_rest = nodes[nodes.index(node):]
+                break
             key = node.params["key"]
             if not isinstance(key, int):
                 raise NotImplementedError(
@@ -292,4 +300,132 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
         branches=branches,
         side_outputs=side_outputs,
         time_characteristic=env.time_characteristic,
+        chain_rest=chain_rest,
+    )
+
+
+def build_plan_chain(env, sink_nodes: List[Node]) -> List[JobPlan]:
+    """Plan a job that may re-key after a stateful operator: each
+    ``key_by``-after-stateful starts a NEW stage whose input is the
+    previous stage's compacted emissions (classic two-stage aggregation,
+    e.g. per-channel windows then a cross-channel rollup). Sink fan-out
+    attaches to the final stage; a stage's record schema resolves at
+    runtime from its upstream program's output schema."""
+    plans = [build_plan(env, sink_nodes)]
+    while plans[-1].chain_rest:
+        prev = plans[-1]
+        plans.append(_plan_rest(env, prev.chain_rest))
+        prev.chain_rest = []
+    if len(plans) > 1:
+        # branches/sinks live on the LAST stage; intermediates feed the
+        # chain glue in the executor. (Late side outputs stay on
+        # plans[0]: they belong to stage 1's window and dispatch from
+        # its runner.)
+        plans[-1].branches = plans[0].branches
+        plans[0].branches = []
+    return plans
+
+
+def _plan_rest(env, rest: List[Node]) -> JobPlan:
+    """Plan a post-chain stage: input records arrive COLUMNAR from the
+    upstream stage (record_kinds filled at runtime from its program's
+    output schema), so there is no host parse stage, no timestamp
+    assigner, and only device ops.
+
+    NOTE: the operator dispatch here is a lean twin of build_plan's walk
+    (minus the raw/host stage) — keep StatefulSpec construction and the
+    ordering errors in lockstep with it."""
+    device_pre: List[tuple] = []
+    device_post: List[tuple] = []
+    key_pos: Optional[int] = None
+    stateful: Optional[StatefulSpec] = None
+    pending_window: Optional[Node] = None
+    chain_rest: List[Node] = []
+
+    for i, node in enumerate(rest):
+        op = node.op
+        if op in ("sink_print", "sink_collect", "sink_fn"):
+            continue
+        if op in ("map", "filter"):
+            target = device_post if stateful is not None else device_pre
+            target.append((op, node.params["fn"]))
+            continue
+        if op == "key_by":
+            if stateful is not None:
+                chain_rest = rest[i:]
+                break
+            key = node.params["key"]
+            if not isinstance(key, int):
+                raise NotImplementedError(
+                    "key_by currently takes a tuple field index"
+                )
+            key_pos = key
+            continue
+        if op == "rolling":
+            if key_pos is None:
+                raise RuntimeError("rolling aggregates require key_by")
+            stateful = StatefulSpec(
+                "rolling",
+                rolling_kind=node.params["kind"],
+                rolling_pos=node.params["pos"],
+            )
+            continue
+        if op == "rolling_reduce":
+            if key_pos is None:
+                raise RuntimeError("reduce on a keyed stream requires key_by")
+            stateful = StatefulSpec(
+                "rolling_reduce", rolling_fn=node.params["fn"]
+            )
+            continue
+        if op == "window":
+            if key_pos is None:
+                raise RuntimeError("windows require key_by")
+            pending_window = node
+            continue
+        if op in ("window_reduce", "window_aggregate", "window_process"):
+            assert pending_window is not None
+            spec: WindowSpec = pending_window.params["spec"]
+            if spec.time_domain == TimeCharacteristic.EventTime:
+                raise NotImplementedError(
+                    "chained stages run windows in PROCESSING time only: "
+                    "upstream emissions carry no event timestamps (set "
+                    "ProcessingTime, or window before the re-key)"
+                )
+            stateful = StatefulSpec(
+                "window",
+                window=spec,
+                apply_kind=op.removeprefix("window_"),
+                apply_fn=node.params.get("fn"),
+                allowed_lateness_ms=pending_window.params.get(
+                    "allowed_lateness_ms", 0
+                ),
+                late_tag=pending_window.params.get("late_tag"),
+            )
+            pending_window = None
+            continue
+        raise NotImplementedError(
+            f"operator {op} is not supported in a chained stage"
+        )
+    if key_pos is None or stateful is None:
+        raise NotImplementedError(
+            "a chained stage needs key_by followed by a stateful operator"
+        )
+
+    return JobPlan(
+        source=None,
+        host_ops=[],
+        ts_assigner=None,
+        ts_expr=None,
+        ts_delay_ms=0,
+        punctuated=False,
+        record_kinds=[],     # filled from the upstream program's schema
+        tables=[],
+        device_pre=device_pre,
+        key_pos=key_pos,
+        stateful=stateful,
+        device_post=device_post,
+        branches=[],
+        side_outputs=[],
+        time_characteristic=TimeCharacteristic.ProcessingTime,
+        chain_rest=chain_rest,
     )
