@@ -110,15 +110,161 @@ def test_count_window_sharded_matches_single_chip():
     assert s8["window_fires"] == s1["window_fires"] == 3
 
 
-def test_count_window_process_rejected():
-    env = StreamExecutionEnvironment(StreamConfig(key_capacity=16))
-    text = env.add_source(ReplaySource(["a 1"]))
-    (
+# ---------------------------------------------------------------------------
+# sliding count windows: countWindow(size, slide) fires at every slide-th
+# element of a key over the last min(size, seen) elements (Flink's
+# CountTrigger.of(slide) + CountEvictor.of(size) pairing)
+# ---------------------------------------------------------------------------
+
+
+def oracle_sliding_sum(lines, size, slide):
+    """Record-at-a-time Flink oracle for countWindow(size, slide).sum."""
+    hist: dict = {}
+    out = []
+    for line in lines:
+        k, v = line.split(" ")
+        hist.setdefault(k, []).append(float(v))
+        if len(hist[k]) % slide == 0:
+            out.append((k, sum(hist[k][-size:])))
+    return out
+
+
+def run_sliding_reduce(lines, size, slide, **cfg):
+    cfg.setdefault("batch_size", 4)
+    cfg.setdefault("key_capacity", 16)
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(lines))
+    handle = (
         text.map(parse)
         .key_by(0)
-        .count_window(2)
-        .process(lambda key, ctx, elements, out: out.collect(0.0))
+        .count_window(size, slide)
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
         .collect()
     )
-    with pytest.raises(NotImplementedError, match="count_window"):
-        env.execute("count-process")
+    env.execute("count-sliding")
+    return [(t.f0, t.f1) for t in handle.items], env.metrics.summary()
+
+
+def test_sliding_count_window_matches_oracle():
+    rows, s = run_sliding_reduce(LINES, 3, 2)
+    expect = oracle_sliding_sum(LINES, 3, 2)
+    assert sorted(rows) == sorted(expect)
+    assert s["window_fires"] == len(expect)
+
+
+def test_sliding_count_window_partial_first_windows():
+    # slide < size: the first fires see fewer than `size` elements
+    lines = [f"k {2 ** i}" for i in range(7)]
+    rows, _ = run_sliding_reduce(lines, 4, 2, batch_size=16)
+    assert rows == oracle_sliding_sum(lines, 4, 2)
+
+
+def test_sliding_count_window_batch_invariance_fuzz():
+    import random
+
+    rng = random.Random(7)
+    lines = [
+        f"{rng.choice('abcd')} {rng.randint(1, 9)}" for _ in range(60)
+    ]
+    expect = oracle_sliding_sum(lines, 5, 3)
+    for bs in (1, 4, 17, 64):
+        rows, _ = run_sliding_reduce(lines, 5, 3, batch_size=bs)
+        assert sorted(rows) == sorted(expect), f"batch_size={bs}"
+
+
+def test_sliding_count_window_sharded_matches_single_chip():
+    single, s1 = run_sliding_reduce(LINES, 3, 2)
+    sharded, s8 = run_sliding_reduce(
+        LINES, 3, 2, parallelism=8, batch_size=16, key_capacity=64,
+        print_parallelism=1,
+    )
+    assert sorted(sharded) == sorted(single)
+    assert s8["window_fires"] == s1["window_fires"]
+
+
+def test_sliding_count_window_wraps_log_across_batches():
+    # more than `size` elements per key across several batches: the
+    # circular element log must overwrite oldest-first (slide != size so
+    # this routes to the element-log program, not the tumbling one)
+    lines = [f"k {i}" for i in range(1, 23)]
+    expect = oracle_sliding_sum(lines, 4, 2)
+    rows, _ = run_sliding_reduce(lines, 4, 2, batch_size=3)
+    assert rows == expect
+
+
+# ---------------------------------------------------------------------------
+# count_window(...).process(): full-window function on count windows
+# ---------------------------------------------------------------------------
+
+
+def run_process(lines, size, slide=None, **cfg):
+    cfg.setdefault("batch_size", 4)
+    cfg.setdefault("key_capacity", 16)
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(lines))
+
+    def fn(key, ctx, elements, out):
+        vals = [e.f1 for e in elements]
+        out.collect(Tuple2(key, vals))
+
+    handle = (
+        text.map(parse).key_by(0).count_window(size, slide).process(fn).collect()
+    )
+    env.execute("count-process")
+    return [(t.f0, t.f1) for t in handle.items], env.metrics.summary()
+
+
+def oracle_process(lines, size, slide):
+    hist: dict = {}
+    out = []
+    for line in lines:
+        k, v = line.split(" ")
+        hist.setdefault(k, []).append(float(v))
+        if len(hist[k]) % slide == 0:
+            out.append((k, hist[k][-size:]))
+    return out
+
+
+def test_count_window_process_tumbling():
+    rows, s = run_process(LINES, 3)
+    expect = oracle_process(LINES, 3, 3)
+    assert sorted(rows) == sorted(expect)
+    assert s["window_fires"] == len(expect)
+
+
+def test_count_window_process_sliding_elements_in_arrival_order():
+    lines = [f"k {i}" for i in range(1, 12)]
+    rows, _ = run_process(lines, 4, 2, batch_size=5)
+    assert rows == oracle_process(lines, 4, 2)
+
+
+def test_count_window_process_batch_invariance():
+    import random
+
+    rng = random.Random(3)
+    lines = [f"{rng.choice('ab')} {rng.randint(1, 9)}" for _ in range(30)]
+    expect = oracle_process(lines, 3, 2)
+    for bs in (1, 7, 32):
+        rows, _ = run_process(lines, 3, 2, batch_size=bs)
+        assert sorted(rows) == sorted(expect)
+
+
+def test_count_window_process_sharded_matches_single_chip():
+    single, _ = run_process(LINES, 3)
+    sharded, s8 = run_process(
+        LINES, 3, parallelism=8, batch_size=16, key_capacity=64,
+        print_parallelism=1,
+    )
+    assert sorted(sharded) == sorted(single)
+
+
+def test_count_window_process_sharded_key_skew_no_loss():
+    # all records hash to ONE shard: its post-exchange rows equal the
+    # GLOBAL batch, so fire rows must be sized for the whole batch
+    lines = [f"k {i}" for i in range(16)]
+    rows, s = run_process(
+        lines, 2, 1, parallelism=8, batch_size=16, key_capacity=64,
+        print_parallelism=1, strict_overflow=True,
+    )
+    assert rows == oracle_process(lines, 2, 1)
+    assert s["alert_overflow"] == 0

@@ -134,9 +134,10 @@ class KeyedStream(DataStream):
 
     timeWindow = time_window
 
-    def count_window(self, count: int) -> "WindowedStream":
+    def count_window(self, count: int, slide: Optional[int] = None) -> "WindowedStream":
         return WindowedStream(
-            self.env, Node("window", self.node, {"spec": count_window_spec(count)})
+            self.env,
+            Node("window", self.node, {"spec": count_window_spec(count, slide)}),
         )
 
     countWindow = count_window

@@ -28,6 +28,7 @@ class WindowSpec:
     slide_ms: int = 0             # == size_ms for tumbling
     gap_ms: int = 0               # session gap
     count: int = 0                # count windows
+    count_slide: int = 0          # sliding count windows (== count for tumbling)
     time_domain: TimeCharacteristic = TimeCharacteristic.ProcessingTime
 
     @property
@@ -114,5 +115,12 @@ def time_window_spec(
     return WindowSpec("sliding", s, slide.to_milliseconds(), time_domain=domain)
 
 
-def count_window_spec(count: int) -> WindowSpec:
-    return WindowSpec("count", count=int(count))
+def count_window_spec(count: int, slide: Optional[int] = None) -> WindowSpec:
+    """``countWindow(size)`` tumbles every ``size`` elements;
+    ``countWindow(size, slide)`` fires every ``slide`` elements over the
+    last ``size`` (Flink's CountTrigger + CountEvictor pairing)."""
+    return WindowSpec(
+        "count",
+        count=int(count),
+        count_slide=int(count if slide is None else slide),
+    )

@@ -34,12 +34,16 @@ class StreamConfig:
 
     # -- emission / alerts --------------------------------------------------
     alert_capacity: int = 65536       # compacted device->host alert slots/step
-    fire_capacity: Optional[int] = None  # SESSION windows only: fired
+    fire_capacity: Optional[int] = None  # session windows: fired
                                          # (key, session) rows composed per
-                                         # step before the post-chain filter;
-                                         # None = key_capacity. Time windows
-                                         # compose fires densely and don't
-                                         # use this. Overflow beyond either
+                                         # step before the post-chain filter
+                                         # (None = key_capacity). Count
+                                         # process() windows: bound on the
+                                         # per-step [fires, size] element
+                                         # matrices (None = batch_size,
+                                         # exact). Time windows compose
+                                         # fires densely and don't use
+                                         # this. Overflow beyond either
                                          # capacity is counted in
                                          # state["alert_overflow"].
 

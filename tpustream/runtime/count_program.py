@@ -19,7 +19,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..api.functions import Collector, WindowContext, as_callable
+from ..api.tuples import make_tuple
+from .process_program import host_value, run_post_ops
 from ..ops.segments import (
     inverse_permutation,
     segment_ranks,
@@ -48,22 +52,23 @@ class CountWindowProgram(WindowProgram):
         spec = st.window
         self.key_pos = plan.key_pos
         self.apply_kind = st.apply_kind
-        if self.apply_kind == "process":
-            raise NotImplementedError(
-                "count_window supports reduce/aggregate; use a time window "
-                "for full-window process() functions"
-            )
         self.count_n = int(spec.count)
         if self.count_n < 1:
             raise ValueError(f"count_window size must be >= 1, got {spec.count}")
         self.n_shards = 1
         self.local_key_capacity = cfg.key_capacity
         self._build_agg()
-        self.post_chain = DeviceChain(
-            plan.device_post, self.result_kinds, self.result_tables
-        )
-        self.out_kinds = self.post_chain.out_kinds
-        self.out_tables = self.post_chain.out_tables
+        if self.apply_kind == "process":
+            # post ops run on the host over user-collected results
+            self.post_chain = None
+            self.out_kinds = list(self.result_kinds)
+            self.out_tables = list(self.result_tables)
+        else:
+            self.post_chain = DeviceChain(
+                plan.device_post, self.result_kinds, self.result_tables
+            )
+            self.out_kinds = self.post_chain.out_kinds
+            self.out_tables = self.post_chain.out_tables
 
     def init_state(self):
         k = self.cfg.key_capacity
@@ -150,3 +155,349 @@ class CountWindowProgram(WindowProgram):
                 "order": self._row_offset(b) + inv.astype(jnp.int32),
             }
         }
+
+
+class _ElementLogMixin:
+    """Shared machinery for the count-window variants that need the last
+    ``size`` elements per key (sliding reduce/aggregate, and process):
+    a per-key circular element log ``[K, size]`` plus a per-key total
+    element count, updated with ONE unique-index scatter per leaf
+    (last-writer-wins when a batch wraps the log).
+
+    Flink's ``countWindow(size, slide)`` is CountTrigger.of(slide) over
+    a GlobalWindow with CountEvictor.of(size): a fire happens at every
+    ``slide``-th element of a key and sees the most recent
+    ``min(size, seen)`` elements in arrival order.
+    """
+
+    def _sorted_batch(self, state, keys, mask):
+        """Sort the batch by key and derive each record's global per-key
+        element index. Returns a dict of the per-row arrays the fire and
+        log-update steps share."""
+        K = state["tot"].shape[0]
+        perm, sk, sv, seg_starts = sort_by_key(keys, mask, max_key=K)
+        rank = segment_ranks(seg_starts)                   # int32
+        safe_sk = jnp.where(sv, sk, 0).astype(jnp.int32)
+        prev = state["tot"][safe_sk]                       # int64
+        idx = prev + rank                                  # element index
+        b = sv.shape[0]
+        pos = jnp.arange(b, dtype=jnp.int32)
+        seg_first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(seg_starts, pos, 0)
+        )
+        # position of each row's segment END (for last-writer detection)
+        rev_start = jnp.flip(segment_tails(seg_starts))
+        rev_first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(rev_start, pos, 0)
+        )
+        seg_last = (b - 1) - jnp.flip(rev_first)
+        return dict(
+            perm=perm, sk=sk, sv=sv, seg_starts=seg_starts,
+            safe_sk=safe_sk, prev=prev, idx=idx,
+            seg_first=seg_first, seg_last=seg_last, pos=pos, K=K,
+        )
+
+    def _element_at(self, sb, log_leaves, batch_leaves, e):
+        """Value of element index ``e`` (per-row int64): from the sorted
+        batch when ``e >= prev`` (it arrived this step), else from the
+        circular log. ``e`` must be a valid index for the rows where the
+        result is consumed; other rows read clamped garbage."""
+        N = self.count_n
+        b = sb["sv"].shape[0]
+        in_batch = e >= sb["prev"]
+        bpos = jnp.clip(
+            sb["seg_first"] + (e - sb["prev"]).astype(jnp.int32), 0, b - 1
+        )
+        e0 = jnp.maximum(e, 0)
+        flat = sb["safe_sk"].astype(jnp.int64) * N + jnp.mod(e0, N)
+        return tuple(
+            jnp.where(in_batch, bl[bpos], lg.reshape(-1)[flat])
+            for bl, lg in zip(batch_leaves, log_leaves)
+        )
+
+    def _update_log(self, state, sb, batch_leaves):
+        """Write the batch into the circular log (last writer per
+        (key, slot) wins — writers to one residue sit exactly ``size``
+        apart in the sorted order) and advance per-key totals."""
+        N = self.count_n
+        K = sb["K"]
+        is_last = sb["sv"] & (sb["pos"] + N > sb["seg_last"])
+        flat_idx = jnp.where(
+            is_last,
+            sb["safe_sk"].astype(jnp.int64) * N + jnp.mod(sb["idx"], N),
+            jnp.int64(K) * N,
+        )
+        new_log = [
+            lg.reshape(-1)
+            .at[flat_idx]
+            .set(bl.astype(lg.dtype), mode="drop", unique_indices=True)
+            .reshape(K, N)
+            for lg, bl in zip(state["ebuf"], batch_leaves)
+        ]
+        tails = segment_tails(sb["seg_starts"]) & sb["sv"]
+        new_tot = state["tot"].at[
+            jnp.where(tails, sb["sk"], K).astype(jnp.int32)
+        ].set(sb["idx"] + 1, mode="drop", unique_indices=True)
+        return new_log, new_tot
+
+
+class SlidingCountWindowProgram(_ElementLogMixin, CountWindowProgram):
+    """``count_window(size, slide)`` with incremental reduce/aggregate.
+
+    Unlike the tumbling program, sliding count windows overlap, so the
+    accumulator cannot be folded destructively; instead each fire folds
+    its ``min(size, seen)`` most recent elements from the circular log +
+    the current sorted batch, oldest first, via a ``size``-step scan of
+    the user combiner over [B]-wide lanes. Per-step cost is
+    O(size * batch) combines — the price of Flink's evictor semantics;
+    prefer tumbling counts when windows don't overlap.
+    """
+
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self.count_slide = int(plan.stateful.window.count_slide)
+        if self.count_slide < 1:
+            raise ValueError(
+                f"count_window slide must be >= 1, got {self.count_slide}"
+            )
+
+    def init_state(self):
+        k, n = self.cfg.key_capacity, self.count_n
+        return {
+            "ebuf": [
+                jnp.zeros((k, n), dtype=self._acc_dtype(kd))
+                for kd in self.acc_kinds
+            ],
+            "tot": jnp.zeros((k,), dtype=jnp.int64),
+            "window_fires": jnp.zeros((), dtype=jnp.int64),
+            "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
+        }
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+        N, S = self.count_n, self.count_slide
+
+        sb = self._sorted_batch(state, keys, mask)
+        sorted_cols = [c[sb["perm"]] for c in mid_cols]
+        lifted = list(self.lift(tuple(sorted_cols)))
+        idx, sv = sb["idx"], sb["sv"]
+        fire = (jnp.mod(idx + 1, S) == 0) & sv
+
+        # fold the window, oldest element first: j counts back from the
+        # fire element, so element index e = idx - j; j <= idx bounds the
+        # window at min(size, idx+1) elements
+        b = sv.shape[0]
+
+        def fold_j(carry, j):
+            has, acc = carry
+            e = idx - j
+            include = (j <= idx) & sv
+            vals = self._element_at(sb, state["ebuf"], lifted, e)
+            merged = self.combine(acc, vals)
+            new_acc = tuple(
+                jnp.where(include & has, m, jnp.where(include, v, a))
+                for m, v, a in zip(merged, vals, acc)
+            )
+            return (has | include, new_acc), None
+
+        from ..ops import panes as pane_ops
+
+        v = lambda x: pane_ops.vary(x, self.vary_axes)
+        has0 = v(jnp.zeros((b,), dtype=bool))
+        acc0 = tuple(
+            v(jnp.zeros((b,), dtype=self._acc_dtype(kd)))
+            for kd in self.acc_kinds
+        )
+        (_, folded), _ = jax.lax.scan(
+            fold_j, (has0, acc0), jnp.arange(N - 1, -1, -1, dtype=jnp.int64)
+        )
+
+        results = self.finalize(folded)
+        post_cols, post_mask = self.post_chain.apply(list(results), fire)
+
+        new_log, new_tot = self._update_log(state, sb, lifted)
+        inv = inverse_permutation(sb["perm"])
+        n_shards = max(1, self.cfg.parallelism)
+        subtask = self._global_key_ids(sb["safe_sk"]) % n_shards
+        new_state = {
+            "ebuf": new_log,
+            "tot": new_tot,
+            "window_fires": state["window_fires"]
+            + self._global_sum(jnp.sum(fire).astype(jnp.int64)),
+            "exchange_overflow": state["exchange_overflow"]
+            + self._global_sum(xovf),
+        }
+        return new_state, {
+            "main": {
+                "mask": post_mask,
+                "cols": tuple(post_cols),
+                "subtask": subtask,
+                "order": self._row_offset(b) + inv.astype(jnp.int32),
+            }
+        }
+
+
+class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
+    """``count_window(size[, slide]).process(fn)``: full-window function
+    over the last ``min(size, seen)`` elements at every ``slide``-th
+    element of a key (chapter2/README.md:177-196's contract on the count
+    taxonomy of chapter3/README.md:4).
+
+    Unlike the time-window process path, the fired elements ride the
+    emission itself (gathered on device into ``[fire_capacity, size]``
+    element matrices), so the executor needs no state synchronization
+    and emission pipelining stays on.
+    """
+
+    def _build_agg(self):
+        # no incremental aggregation: the "accumulator" is the raw record
+        self.acc_kinds = list(self.mid_kinds)
+        self.result_kinds = list(self.mid_kinds)
+        self.result_tables = list(self.mid_tables)
+        self.lift = lambda cols: tuple(cols)
+        self.combine = None
+        self.finalize = None
+        self.process_fn = as_callable(self.plan.stateful.apply_fn, "process")
+
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self.count_slide = int(plan.stateful.window.count_slide)
+        if self.count_slide < 1:
+            raise ValueError(
+                f"count_window slide must be >= 1, got {self.count_slide}"
+            )
+        # fires are per-record flags on POST-exchange rows: under key
+        # skew one shard can receive the whole global batch, so the
+        # exact bound is the full batch size, not batch/shards;
+        # fire_capacity can shrink the [F, size] element matrices for
+        # memory (overflow counted, strict mode fails)
+        b = cfg.batch_size
+        self.fire_rows = min(b, cfg.fire_capacity or b)
+
+    @property
+    def host_evaluated(self) -> bool:
+        return True
+
+    def init_state(self):
+        # window fires are counted host-side in evaluate_fires (the
+        # process-path convention — see ProcessWindowProgram)
+        k, n = self.cfg.key_capacity, self.count_n
+        return {
+            "ebuf": [
+                jnp.zeros((k, n), dtype=self._acc_dtype(kd))
+                for kd in self.acc_kinds
+            ],
+            "tot": jnp.zeros((k,), dtype=jnp.int64),
+            "alert_overflow": jnp.zeros((), dtype=jnp.int64),
+            "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
+        }
+
+    def _step(self, state, cols, valid, ts, wm_lower):
+        from ..ops import panes as pane_ops
+
+        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        keys = self._local_keys(mid_cols[self.key_pos])
+        N, S = self.count_n, self.count_slide
+
+        sb = self._sorted_batch(state, keys, mask)
+        sorted_cols = [c[sb["perm"]] for c in mid_cols]
+        idx, sv = sb["idx"], sb["sv"]
+        fire = (jnp.mod(idx + 1, S) == 0) & sv
+
+        fidx, fvalid, fovf, _ = pane_ops.compact(fire, [], self.fire_rows)
+        f_idx = idx[fidx]                     # fire element's index
+        f_m = jnp.minimum(jnp.int64(N), f_idx + 1)  # elements in window
+        # element j (0..N-1) of the fired window, OLDEST first:
+        # e = f_idx - (m - 1) + j, valid while j < m
+        j = jnp.arange(N, dtype=jnp.int64)[None, :]
+        e = (f_idx - f_m + 1)[:, None] + j    # [F, N]
+        f_prev = sb["prev"][fidx][:, None]
+        in_batch = e >= f_prev
+        bpos = jnp.clip(
+            sb["seg_first"][fidx][:, None] + (e - f_prev).astype(jnp.int32),
+            0, sv.shape[0] - 1,
+        )
+        flat = (
+            sb["safe_sk"][fidx][:, None].astype(jnp.int64) * N
+            + jnp.mod(jnp.maximum(e, 0), N)
+        )
+        elems = [
+            jnp.where(in_batch, bl[bpos], lg.reshape(-1)[flat])
+            for lg, bl in zip(state["ebuf"], sorted_cols)
+        ]
+
+        new_log, new_tot = self._update_log(state, sb, sorted_cols)
+        n_fired = jnp.sum(fire).astype(jnp.int64)
+        new_state = {
+            "ebuf": new_log,
+            "tot": new_tot,
+            "alert_overflow": state["alert_overflow"] + self._global_sum(fovf),
+            "exchange_overflow": state["exchange_overflow"]
+            + self._global_sum(xovf),
+        }
+        emissions = {
+            "process_fire": {
+                "fire": n_fired[None],
+                "valid": fvalid,
+                "elems": tuple(elems),
+                "m": f_m,
+                "key": self._global_key_ids(sb["safe_sk"][fidx]),
+                # closing record's arrival position, for emission order
+                "arr": self._row_offset(sv.shape[0])
+                + sb["perm"][fidx].astype(jnp.int32),
+            }
+        }
+        return new_state, emissions
+
+    # ------------------------------------------------------------------
+    def evaluate_fires(self, state, fire_info, post_ops, emit):
+        """Host callback: the fired windows' elements arrived IN the
+        emission payload (state is not consulted). Emits in the arrival
+        order of each window's closing record, matching the per-record
+        trigger order of Flink's count windows."""
+        total = int(np.asarray(fire_info["fire"]).reshape(-1).sum())
+        if total == 0:
+            return 0, 0
+        N = self.count_n
+        valid = np.asarray(fire_info["valid"]).reshape(-1)
+        elems = [np.asarray(x).reshape(-1, N) for x in fire_info["elems"]]
+        m = np.asarray(fire_info["m"]).reshape(-1)
+        key = np.asarray(fire_info["key"]).reshape(-1)
+        arr = np.asarray(fire_info["arr"]).reshape(-1)
+        kinds, tables = self.mid_kinds, self.mid_tables
+        key_table = tables[self.key_pos]
+
+        rows = np.nonzero(valid)[0]
+        rows = rows[np.argsort(arr[rows], kind="stable")]
+        emitted = 0
+        fired = 0
+        for r in rows:
+            mm = int(m[r])
+            elements = []
+            for jj in range(mm):
+                vals = [
+                    self._value(kd, tb, e_[r, jj])
+                    for kd, tb, e_ in zip(kinds, tables, elems)
+                ]
+                elements.append(vals[0] if len(vals) == 1 else make_tuple(*vals))
+            key_id = int(key[r])
+            key_val = (
+                key_table.lookup(key_id) if key_table is not None else key_id
+            )
+            # count windows live in Flink's GlobalWindow: no time bounds
+            ctx = WindowContext(0, 2**62, -(2**62))
+            fired += 1
+            out = Collector()
+            self.process_fn(key_val, ctx, elements, out)
+            for item in out.items:
+                item, keep = run_post_ops(item, post_ops)
+                if keep:
+                    emit(item, key_id % max(1, self.n_shards))
+                    emitted += 1
+        return emitted, fired
+
+    def _value(self, kind, table, v):
+        return host_value(kind, table, v)

@@ -36,6 +36,29 @@ from .step import BaseProgram
 from .window_program import WindowProgram
 
 
+def host_value(kind, table, v):
+    """Decode one stored device scalar back to its Python value (shared
+    by every host-evaluated process() path: time, session, count)."""
+    if kind == STR:
+        return table.lookup(int(v)) if int(v) >= 0 else None
+    if kind == F64:
+        return float(v)
+    if kind == BOOL:
+        return bool(v)
+    return int(v)
+
+
+def run_post_ops(item, post_ops):
+    """Apply a window's host-side post map/filter tail to one collected
+    result. Returns (item, keep)."""
+    for op, fn in post_ops:
+        if op == "map":
+            item = as_callable(fn, "map")(item)
+        elif not as_callable(fn, "filter")(item):
+            return item, False
+    return item, True
+
+
 class ProcessWindowProgram(WindowProgram):
     """Shares the watermark/ring/late machinery of WindowProgram but stores
     raw elements and defers evaluation to a host callback."""
@@ -248,13 +271,7 @@ class ProcessWindowProgram(WindowProgram):
     # host-side window evaluation
     # ------------------------------------------------------------------
     def _value(self, kind, table, v):
-        if kind == STR:
-            return table.lookup(int(v)) if int(v) >= 0 else None
-        if kind == F64:
-            return float(v)
-        if kind == BOOL:
-            return bool(v)
-        return int(v)
+        return host_value(kind, table, v)
 
     def evaluate_fires(self, state, fire_info, post_ops, emit):
         """Host callback: gather fired windows' elements, run the user
@@ -316,12 +333,7 @@ class ProcessWindowProgram(WindowProgram):
                 out = Collector()
                 self.process_fn(key_val, ctx, elements, out)
                 for item in out.items:
-                    keep = True
-                    for op, fn in post_ops:
-                        if op == "map":
-                            item = as_callable(fn, "map")(item)
-                        else:
-                            keep = keep and bool(as_callable(fn, "filter")(item))
+                    item, keep = run_post_ops(item, post_ops)
                     if keep:
                         emit(item, key_id % S)
                         emitted += 1

@@ -32,7 +32,7 @@ from ..ops import sessions as sess_ops
 from ..ops.panes import W0
 from ..ops.sessions import TS_MAX
 from .plan import JobPlan
-from .process_program import ProcessWindowProgram
+from .process_program import ProcessWindowProgram, run_post_ops
 from .window_program import WindowProgram
 
 
@@ -550,14 +550,7 @@ class SessionProcessProgram(ProcessWindowProgram):
                 out = Collector()
                 self.process_fn(key_val, ctx, elements, out)
                 for item in out.items:
-                    keep = True
-                    for op, fn in post_ops:
-                        if op == "map":
-                            item = as_callable(fn, "map")(item)
-                        else:
-                            keep = keep and bool(
-                                as_callable(fn, "filter")(item)
-                            )
+                    item, keep = run_post_ops(item, post_ops)
                     if keep:
                         emit(item, key_id % max(1, self.n_shards))
                         emitted += 1

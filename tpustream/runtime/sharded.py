@@ -21,7 +21,11 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.exchange import exchange_by_key
 from ..parallel.mesh import AXIS, make_mesh
-from .count_program import CountWindowProgram
+from .count_program import (
+    CountProcessProgram,
+    CountWindowProgram,
+    SlidingCountWindowProgram,
+)
 from .plan import JobPlan
 from .process_program import ProcessWindowProgram
 from .session_program import SessionWindowProgram
@@ -130,6 +134,28 @@ class ShardedRollingProgram(_ShardedMixin, RollingProgram):
 
 
 class ShardedCountWindowProgram(_ShardedMixin, CountWindowProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit()
+
+
+class ShardedSlidingCountWindowProgram(_ShardedMixin, SlidingCountWindowProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit()
+
+
+class ShardedCountProcessProgram(_ShardedMixin, CountProcessProgram):
+    """Count-window process() at parallelism N: emission payloads carry
+    GLOBAL key ids and per-shard element matrices, so the host callback
+    needs no shard-aware row mapping."""
+
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         self._setup_sharding(cfg)

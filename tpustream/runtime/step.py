@@ -275,6 +275,24 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
         return RollingProgram(plan, cfg)
     if plan.stateful.kind == "window":
         if plan.stateful.window is not None and plan.stateful.window.kind == "count":
+            spec = plan.stateful.window
+            sliding = spec.count_slide and spec.count_slide != spec.count
+            if plan.stateful.apply_kind == "process":
+                if sharded:
+                    from .sharded import ShardedCountProcessProgram
+
+                    return ShardedCountProcessProgram(plan, cfg)
+                from .count_program import CountProcessProgram
+
+                return CountProcessProgram(plan, cfg)
+            if sliding:
+                if sharded:
+                    from .sharded import ShardedSlidingCountWindowProgram
+
+                    return ShardedSlidingCountWindowProgram(plan, cfg)
+                from .count_program import SlidingCountWindowProgram
+
+                return SlidingCountWindowProgram(plan, cfg)
             if sharded:
                 from .sharded import ShardedCountWindowProgram
 
