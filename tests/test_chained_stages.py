@@ -3,8 +3,11 @@
 Classic two-stage aggregation — per-key windows, then a cross-key rollup
 keyed by a different field — runs as two compiled device programs, the
 second fed by the first's compacted emissions (build_plan_chain /
-Runner.pump_chain). Stage-2 time semantics are processing time (upstream
-emissions carry no event timestamps).
+Runner.pump_chain). Round 3 (VERDICT r2 next #1): stages run at
+parallelism N, stage-2 windows may use EVENT time (window results carry
+Flink's ``end - 1`` result timestamp; rolling stages forward the record
+timestamp), chains checkpoint/resume, and chaining after a full-window
+process() stage resolves its schema from the collected rows.
 """
 
 import pytest
@@ -104,35 +107,134 @@ def test_window_then_rekeyed_processing_time_window():
     ]
 
 
-def test_chained_stage_rejects_event_time_windows():
-    env = StreamExecutionEnvironment(
-        StreamConfig(batch_size=2, key_capacity=16)
-    )
+def _run_event_time_two_stage(**cfg):
+    """Stage 1: 10 s event-time windows per host; stage 2: 30 s
+    EVENT-time windows per cpu over the stage-1 results (their event
+    timestamps are the stage-1 window ends - 1)."""
+    cfg.setdefault("batch_size", 2)
+    cfg.setdefault("key_capacity", 16)
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     text = env.add_source(ReplaySource(LINES))
-    (
+    handle = (
         text.assign_timestamps_and_watermarks(Ts())
         .map(parse)
         .key_by(0)
         .time_window(Time.seconds(10))
         .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
         .key_by(1)
-        .time_window(Time.seconds(10))
+        .time_window(Time.seconds(30))
         .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
         .collect()
     )
-    with pytest.raises(NotImplementedError, match="PROCESSING time"):
-        env.execute("two-stage-event-window")
+    env.execute("two-stage-event-window")
+    return sorted(tuple(t) for t in handle.items), env.metrics.summary()
 
 
-def test_chained_stage_rejects_parallelism_and_checkpoints(tmp_path):
-    for cfg in (
-        StreamConfig(batch_size=4, parallelism=2, key_capacity=16),
-        StreamConfig(batch_size=4, checkpoint_dir=str(tmp_path),
-                     checkpoint_interval_batches=1, key_capacity=16),
-    ):
-        env = StreamExecutionEnvironment(cfg)
+def test_chained_event_time_windows():
+    got, _ = _run_event_time_two_stage()
+    # stage-1 fires: (a,x,8)@9999, (b,y,7)@9999, (a,y,4)@19999, (b,x,9)@29999
+    # stage-2 30s windows keyed by cpu: [0,30s) x: 8+9=17, y: 7+4=11
+    assert got == [("a", "x", 17), ("b", "y", 11)]
+
+
+def test_chained_event_time_windows_batch_invariance():
+    expect, _ = _run_event_time_two_stage()
+    for bs in (1, 4, 8):
+        got, _ = _run_event_time_two_stage(batch_size=bs)
+        assert got == expect, f"batch_size={bs}"
+
+
+def test_chained_stages_sharded_matches_single_chip():
+    single, s1 = _run_event_time_two_stage(batch_size=8)
+    sharded, s8 = _run_event_time_two_stage(
+        batch_size=8, parallelism=8, key_capacity=16, print_parallelism=1,
+    )
+    assert sharded == single
+    assert s8["window_fires"] == s1["window_fires"]
+
+
+def test_chained_rolling_sharded_matches_single_chip():
+    def run(parallelism):
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=8, key_capacity=16, parallelism=parallelism)
+        )
         env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
-        _build_two_stage(env).collect()
-        with pytest.raises(NotImplementedError, match="chain"):
-            env.execute("two-stage-restricted")
+        handle = _build_two_stage(env).collect()
+        env.execute("two-stage-sharded")
+        return [tuple(t) for t in handle.items]
+
+    assert sorted(run(8)) == sorted(run(1))
+
+
+def test_chained_stage_checkpoint_resume(tmp_path):
+    """Kill-and-replay resume across BOTH stages: every surviving
+    snapshot resumes to the exact remaining output suffix."""
+    import glob
+    import os
+
+    from tpustream.runtime.checkpoint import load_checkpoint
+
+    def run(ckdir=None, restore=None):
+        cfg = dict(batch_size=1, key_capacity=16)
+        if ckdir is not None:
+            cfg.update(checkpoint_dir=str(ckdir), checkpoint_interval_batches=1)
+        env = StreamExecutionEnvironment(StreamConfig(**cfg))
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        if restore is not None:
+            env.restore_from_checkpoint(restore)
+        handle = _build_two_stage(env).collect()
+        env.execute("two-stage-ckpt")
+        return [tuple(t) for t in handle.items]
+
+    full = run()
+    ckdir = tmp_path / "ck"
+    with_ck = run(ckdir=ckdir)
+    assert with_ck == full
+    snaps = sorted(glob.glob(os.path.join(str(ckdir), "ckpt-*.npz")))
+    assert snaps, "no checkpoints written"
+    for snap in snaps:
+        ck = load_checkpoint(snap)
+        resumed = run(restore=snap)
+        assert resumed == full[ck.emitted:], f"bad resume from {snap}"
+
+
+def test_chain_after_process_stage():
+    """Stage 1 is a full-window process() (median per host); stage 2
+    re-keys the collected rows and windows them in EVENT time — the
+    downstream schema is inferred from the rows the user fn emits."""
+    from tpustream import Tuple2
+
+    def median_process(key, ctx, elements, out):
+        vals = sorted(e.f2 for e in elements)
+        mid = len(vals) // 2
+        med = (
+            float(vals[mid])
+            if len(vals) % 2
+            else (vals[mid - 1] + vals[mid]) / 2
+        )
+        out.collect(Tuple2(key, med))
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(LINES))
+    handle = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .process(median_process)
+        .key_by(0)
+        .time_window(Time.seconds(30))
+        .reduce(lambda p, q: Tuple2(p.f0, p.f1 + q.f1))
+        .collect()
+    )
+    env.execute("process-then-rekey")
+    # stage 1 medians: (a,4.0)@[0,10s), (b,7.0)@[0,10s), (a,4.0)@[10,20s),
+    # (b,9.0)@[20,30s); stage 2 sums them per key in [0,30s)
+    assert sorted(tuple(t) for t in handle.items) == [
+        ("a", 8.0),
+        ("b", 16.0),
+    ]

@@ -139,3 +139,142 @@ def test_two_process_dcn_collectives(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"worker {i}: ok" in out
+
+
+# ---------------------------------------------------------------------------
+# one JOB, two hosts (VERDICT r2 next #2): execute_job spans two real
+# processes over jax.distributed; the union of both hosts' sink output
+# must equal a single-process run byte for byte
+# ---------------------------------------------------------------------------
+
+# 12 channels -> interned key ids 0..11 spread over all 8 shards, so
+# BOTH processes own emitting keys (ids 0..3 would all sit on host 0)
+JOB_LINES = [f"{1000 + i * 500} ch{i % 12} {(i % 7) * 10 + 1}" for i in range(48)]
+
+JOB_SNIPPET = textwrap.dedent(
+    """
+    def run_job(lines):
+        from tpustream import (
+            BoundedOutOfOrdernessTimestampExtractor,
+            StreamExecutionEnvironment,
+            Time,
+            TimeCharacteristic,
+            Tuple3,
+        )
+        from tpustream.config import StreamConfig
+        from tpustream.runtime.sources import ReplaySource
+
+        class Ts(BoundedOutOfOrdernessTimestampExtractor):
+            def __init__(self):
+                super().__init__(Time.milliseconds(2000))
+
+            def extract_timestamp(self, value):
+                return int(value.split(" ")[0])
+
+        def parse(line):
+            p = line.split(" ")
+            return Tuple3(int(p[0]), p[1], int(p[2]))
+
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=16, key_capacity=64, parallelism=8,
+                         alert_capacity=4096)
+        )
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        text = env.add_source(ReplaySource(lines))
+        handle = (
+            text.assign_timestamps_and_watermarks(Ts())
+            .map(parse)
+            .key_by(1)
+            .time_window(Time.seconds(5), Time.seconds(1))
+            .reduce(lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2))
+            .collect()
+        )
+        env.execute("TwoHostJob")
+        return [repr(t) for t in handle.items]
+    """
+)
+
+JOB_WORKER = (
+    textwrap.dedent(
+        """
+        import os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("JAX_COORDINATOR_ADDRESS", None)
+        pid, port = int(sys.argv[1]), sys.argv[2]
+        from tpustream.parallel import distributed
+
+        distributed.initialize(
+            coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        )
+        import jax
+        assert jax.process_count() == 2
+        lines = sys.stdin.read().splitlines()
+        """
+    )
+    + JOB_SNIPPET
+    + textwrap.dedent(
+        """
+        for r in run_job(lines):
+            print("ROW\\t" + r)
+        print(f"worker {pid}: ok")
+        """
+    )
+)
+
+
+def test_two_process_job_matches_single_process(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "job_worker.py"
+    script.write_text(JOB_WORKER)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    # feed BOTH stdin pipes before waiting on either: the workers run
+    # one SPMD program and block on each other's collectives
+    for p in procs:
+        p.stdin.write("\n".join(JOB_LINES))
+        p.stdin.close()
+    outs = []
+    for p in procs:
+        outs.append(p.stdout.read())
+        p.wait(timeout=280)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"job worker {i} failed:\n{out}"
+        assert f"worker {i}: ok" in out
+
+    # each process emits ONLY its own shards' alerts; the union must be
+    # byte-identical to a single-process run at the same parallelism
+    got = sorted(
+        line.split("\t", 1)[1]
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("ROW\t")
+    )
+    ns = {}
+    exec(JOB_SNIPPET, ns)
+    expect = sorted(ns["run_job"](JOB_LINES))
+    assert expect, "single-process reference produced no output"
+    assert got == expect
+    # and the work was actually split: neither process emitted everything
+    per_proc = [
+        sum(1 for line in out.splitlines() if line.startswith("ROW\t"))
+        for out in outs
+    ]
+    assert all(n < len(expect) for n in per_proc), per_proc

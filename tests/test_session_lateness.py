@@ -1,0 +1,312 @@
+"""Session windows x allowed lateness (VERDICT r2 next #6).
+
+The reference documents allowed lateness for time windows
+(chapter3/README.md:209-228) and session windows (:412-428); Flink
+composes the two: fired sessions are retained until ``end - 1 +
+lateness`` passes the watermark, a late record merging into a retained
+(or open) session re-fires the merged session, and only records whose
+MERGED window is past the horizon are dropped. These tests pin that
+composition — including the round-2 divergence where a record whose solo
+window had closed was dropped even though Flink would merge it into a
+surviving session — against a record-at-a-time oracle of Flink's
+merging-window operator (WindowOperator + EventTimeTrigger semantics at
+batch-watermark granularity).
+"""
+
+import numpy as np
+import pytest
+
+from tpustream import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    OutputTag,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple2,
+)
+from tpustream.api.windows import EventTimeSessionWindows
+from tpustream.config import StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+GAP = 10_000
+DELAY = 2_000
+W0 = -(2**62)
+
+
+def parse(value: str) -> Tuple2:
+    items = value.split(" ")
+    return Tuple2(items[1], int(items[2]))
+
+
+class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+    def __init__(self):
+        super().__init__(Time.milliseconds(DELAY))
+
+    def extract_timestamp(self, value: str) -> int:
+        return int(value.split(" ")[0])
+
+
+def flink_session_oracle(batches, gap=GAP, lateness=0, delay=DELAY):
+    """Flink merging-window semantics at batch-watermark granularity.
+
+    Processes each batch's records against the batch-START watermark
+    (insert + merge, drop only if the MERGED window is past the
+    retention horizon), then advances the watermark once per batch and
+    fires every due session that is dirty (gained data since its last
+    fire, or never fired). Fired sessions are retained until
+    ``end - 1 + lateness <= watermark``. Returns (emitted, dropped) with
+    emitted = [(key, sum, window_end)] in no particular order.
+    """
+    wm = W0
+    windows: dict = {}  # key -> list of {min,max,sum,dirty}
+    out, dropped = [], []
+
+    def fire_and_clean(new_wm):
+        for k in list(windows):
+            keep = []
+            for w in windows[k]:
+                if w["max"] + gap - 1 <= new_wm and w["dirty"]:
+                    out.append((k, w["sum"], w["max"] + gap))
+                    w["dirty"] = False
+                if not (w["max"] + gap - 1 + lateness <= new_wm):
+                    keep.append(w)
+            windows[k] = keep
+
+    def try_insert(ts, k, v):
+        sess = windows.setdefault(k, [])
+        merged = {"min": ts, "max": ts, "sum": v, "dirty": True}
+        rest = []
+        for w in sess:
+            if w["min"] < merged["max"] + gap and merged["min"] < w["max"] + gap:
+                merged["min"] = min(merged["min"], w["min"])
+                merged["max"] = max(merged["max"], w["max"])
+                merged["sum"] += w["sum"]
+            else:
+                rest.append(w)
+        if merged["max"] + gap - 1 + lateness <= wm:
+            return False
+        windows[k] = rest + [merged]
+        return True
+
+    for batch in batches:
+        mx = max([ts for ts, _, _ in batch], default=W0)
+        # a batch is a SET of simultaneous arrivals: records rescue each
+        # other regardless of intra-batch order, so insert to a fixpoint
+        # (matches the runtime's order-insensitive rescue closure)
+        pending = list(batch)
+        progress = True
+        while progress and pending:
+            progress = False
+            still = []
+            for ts, k, v in pending:
+                if try_insert(ts, k, v):
+                    progress = True
+                else:
+                    still.append((ts, k, v))
+            pending = still
+        for _, k, v in pending:
+            dropped.append((k, v))
+        wm = max(wm, mx - delay)
+        fire_and_clean(wm)
+    fire_and_clean(2**62)
+    return out, dropped
+
+
+def run_job(recs, lateness_ms=0, batch_size=1, parallelism=1, with_late_tag=False,
+            key_capacity=64):
+    cfg = StreamConfig(
+        batch_size=batch_size,
+        key_capacity=key_capacity,
+        alert_capacity=1024,
+        parallelism=parallelism,
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    lines = [f"{ts} {key} {v}" for ts, key, v in recs]
+    text = env.add_source(ReplaySource(lines))
+    windowed = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP)))
+    )
+    if lateness_ms:
+        windowed = windowed.allowed_lateness(Time.milliseconds(lateness_ms))
+    tag = OutputTag("late") if with_late_tag else None
+    if tag is not None:
+        windowed = windowed.side_output_late_data(tag)
+    stream = windowed.reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+    h = stream.collect()
+    late_h = stream.get_side_output(tag).collect() if tag is not None else None
+    env.execute("SessionLateness")
+    got = sorted((t.f0, t.f1) for t in h.items)
+    late = sorted((t.f0, t.f1) for t in late_h.items) if late_h else []
+    return got, late, env.metrics.summary()
+
+
+def oracle_sums(batches, **kw):
+    out, _ = flink_session_oracle(batches, **kw)
+    return sorted((k, s) for k, s, _ in out)
+
+
+def as_batches(recs, batch_size=1):
+    return [
+        list(recs[i : i + batch_size]) for i in range(0, len(recs), batch_size)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the round-2 divergence: solo-late record merging into a surviving session
+# ---------------------------------------------------------------------------
+
+
+def test_solo_late_record_merges_into_open_session():
+    # session B = [19000, 28000] is open (wm 26000 < end-1 36999);
+    # record at 10000 has solo window [10000,20000) with end-1 19999 <=
+    # wm — round 2 dropped it; Flink merges it into B
+    recs = [
+        (19_000, "a", 1),
+        (28_000, "a", 2),
+        (10_000, "a", 4),
+        (70_000, "a", 8),
+    ]
+    got, _, s = run_job(recs)
+    assert got == oracle_sums(as_batches(recs))
+    assert ("a", 7) in got          # 1+2+4 merged, not 3
+    assert s["late_dropped"] == 0
+
+
+def test_genuinely_late_record_still_dropped():
+    # no surviving overlap: drop (and count) as before
+    recs = [
+        (0, "a", 1),
+        (50_000, "a", 2),   # wm -> 48000; [0,10000) fired AND cleared
+        (5_000, "a", 4),    # overlaps nothing alive: dropped
+        (90_000, "a", 8),
+    ]
+    got, _, s = run_job(recs)
+    assert got == oracle_sums(as_batches(recs))
+    assert s["late_dropped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# allowed_lateness > 0: retention, refires, horizon drops
+# ---------------------------------------------------------------------------
+
+
+def test_late_record_refires_session_within_lateness():
+    L = 30_000
+    recs = [
+        (0, "a", 1),
+        (5_000, "a", 2),
+        (30_000, "a", 4),    # wm -> 28000: [0,5000] fires (sum 3), retained
+        (8_000, "a", 8),     # late, within L: merges + refires (sum 11)
+        (90_000, "a", 16),
+    ]
+    got, _, s = run_job(recs, lateness_ms=L)
+    assert got == oracle_sums(as_batches(recs), lateness=L)
+    assert ("a", 3) in got and ("a", 11) in got
+    assert s["late_dropped"] == 0
+
+
+def test_retained_session_does_not_refire_without_new_data():
+    L = 30_000
+    recs = [
+        (0, "a", 1),
+        (30_000, "a", 2),    # fires [0,10000) sum 1; retained
+        (31_000, "a", 4),    # watermark nudges; retained run must stay quiet
+        (32_000, "a", 8),
+        (99_000, "a", 16),
+    ]
+    got, _, _ = run_job(recs, lateness_ms=L)
+    assert got == oracle_sums(as_batches(recs), lateness=L)
+    assert got.count(("a", 1)) == 1
+
+
+def test_late_record_bridges_two_retained_sessions():
+    L = 60_000
+    recs = [
+        (0, "a", 1),
+        (15_000, "a", 2),     # separate session (gap 15000 >= 10000)
+        (40_000, "a", 4),     # wm -> 38000: fires [0,.) sum 1, [15000,.) sum 2,
+                              # [40000] stays open; first two retained
+        (9_000, "a", 8),      # bridges BOTH retained sessions -> one merged
+                              # refire: 1+2+8 = 11
+        (120_000, "a", 16),
+    ]
+    got, _, s = run_job(recs, lateness_ms=L)
+    assert got == oracle_sums(as_batches(recs), lateness=L)
+    assert ("a", 11) in got
+    assert s["late_dropped"] == 0
+
+
+def test_drop_beyond_lateness_horizon_to_side_output():
+    L = 5_000
+    recs = [
+        (0, "a", 1),
+        (40_000, "a", 2),    # wm -> 38000 > 9999-1+L: [0,10000) cleaned
+        (3_000, "a", 4),     # beyond horizon, overlaps nothing: side output
+        (90_000, "a", 8),
+    ]
+    got, late, s = run_job(recs, lateness_ms=L, with_late_tag=True)
+    assert got == oracle_sums(as_batches(recs), lateness=L)
+    assert late == [("a", 4)]
+    # delivered to a side output, not dropped (Flink counter semantics)
+    assert s["late_dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz with genuine lateness, incl. sharded
+# ---------------------------------------------------------------------------
+
+
+def test_intra_batch_rescue_closure():
+    # the two late-corner records arrive in ONE batch: 40000 is live and
+    # 35000 (hard-late vs wm 48000) must merge into the session 40000
+    # opens — a Flink merge under simultaneous arrival
+    recs = [
+        (0, "a", 1),
+        (50_000, "a", 2),
+        (40_000, "a", 4),
+        (35_000, "a", 8),
+        (120_000, "a", 16),
+    ]
+    got, _, s = run_job(recs, batch_size=2)
+    assert got == oracle_sums(as_batches(recs, 2))
+    assert ("a", 12) in got            # 4 + 8 merged (round-2 dropped the 8)
+    assert s["late_dropped"] == 0
+
+
+@pytest.mark.parametrize("lateness_ms", [0, 15_000])
+@pytest.mark.parametrize("batch_size", [1, 8])
+def test_randomized_stream_matches_flink_oracle(lateness_ms, batch_size):
+    rng = np.random.default_rng(11)
+    t = 0
+    recs = []
+    for _ in range(200):
+        t += int(rng.integers(0, 9_000))
+        key = str(rng.choice(["a", "b", "c"]))
+        # jitter far beyond the watermark delay -> genuinely late records
+        jitter = int(rng.integers(0, 30_000))
+        recs.append((max(0, t - jitter), key, int(rng.integers(1, 100))))
+    got, _, _ = run_job(recs, lateness_ms=lateness_ms, batch_size=batch_size)
+    assert got == oracle_sums(
+        as_batches(recs, batch_size), lateness=lateness_ms
+    )
+
+
+def test_sharded_lateness_matches_single_chip():
+    rng = np.random.default_rng(5)
+    t = 0
+    recs = []
+    for _ in range(150):
+        t += int(rng.integers(0, 9_000))
+        key = str(rng.choice(["a", "b", "c", "d", "e"]))
+        jitter = int(rng.integers(0, 25_000))
+        recs.append((max(0, t - jitter), key, int(rng.integers(1, 50))))
+    single, _, s1 = run_job(recs, lateness_ms=15_000, batch_size=8)
+    sharded, _, s8 = run_job(
+        recs, lateness_ms=15_000, batch_size=8, parallelism=8,
+    )
+    assert sharded == single
+    assert s8["window_fires"] == s1["window_fires"]

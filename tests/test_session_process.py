@@ -200,19 +200,67 @@ def test_session_process_context_bounds():
     assert seen["a"] == (1060000, 1060000 + GAP_MS, 1)
 
 
-def test_sharded_session_process_raises_clearly():
+def _run_medians(recs, parallelism=1, batch_size=4, lateness_ms=0):
     env = StreamExecutionEnvironment(
-        StreamConfig(batch_size=4, key_capacity=16, parallelism=2)
+        StreamConfig(
+            batch_size=batch_size, key_capacity=64, parallelism=parallelism,
+        )
     )
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
-    text = env.add_source(ReplaySource(["1000000 a 1"]))
-    (
+    lines = [f"{ts} {key} {v}" for ts, key, v in recs]
+    text = env.add_source(ReplaySource(lines))
+    w = (
         text.assign_timestamps_and_watermarks(TsExtractor())
         .map(parse)
         .key_by(0)
         .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
-        .process(median_process)
-        .collect()
     )
-    with pytest.raises(NotImplementedError, match="sharded session"):
-        env.execute("sharded-session-process")
+    if lateness_ms:
+        w = w.allowed_lateness(Time.milliseconds(lateness_ms))
+    handle = w.process(median_process).collect()
+    env.execute("sharded-session-process")
+    return sorted((t.f0, t.f1) for t in handle.items)
+
+
+def test_sharded_session_process_matches_single_chip():
+    # round 2's last single-chip-only program shape, now SPMD
+    rng = np.random.default_rng(9)
+    t = 0
+    recs = []
+    for _ in range(120):
+        t += int(rng.integers(0, 12_000))
+        key = str(rng.choice(["a", "b", "c", "d", "e"]))
+        recs.append((t, key, int(rng.integers(1, 50))))
+    single = _run_medians(recs, parallelism=1, batch_size=8)
+    sharded = _run_medians(recs, parallelism=8, batch_size=8)
+    assert sharded == single
+
+
+def test_session_process_lateness_refire():
+    L = 30_000
+    recs = [
+        (1_000_000, "a", 1),
+        (1_005_000, "a", 3),
+        (1_030_000, "a", 9),   # wm 1028000: [1000000,1005000] fires, med 2
+        (1_002_000, "a", 5),   # late, within L: refires merged, med 3
+        (1_090_000, "a", 7),
+    ]
+    got = _run_medians(recs, lateness_ms=L, batch_size=1)
+    assert ("a", 2.0) in got          # on-time fire
+    assert ("a", 3.0) in got          # late refire with element 5 merged
+    # retained sessions refire once per late arrival, not per step
+    assert len([x for x in got if x[0] == "a"]) == 4
+
+
+def test_sharded_session_process_lateness_matches_single_chip():
+    rng = np.random.default_rng(13)
+    t = 0
+    recs = []
+    for _ in range(100):
+        t += int(rng.integers(0, 9_000))
+        key = str(rng.choice(["a", "b", "c"]))
+        jitter = int(rng.integers(0, 25_000))
+        recs.append((max(0, t - jitter), key, int(rng.integers(1, 50))))
+    single = _run_medians(recs, lateness_ms=15_000, batch_size=8)
+    sharded = _run_medians(recs, lateness_ms=15_000, parallelism=8, batch_size=8)
+    assert sharded == single

@@ -135,6 +135,48 @@ def ascending_slot_order(hi_pane, ring: RingSpec):
     return slot, pane_ids
 
 
+def batch_rescue_closure(keys, ts, mask, anchor, gap_ms: int):
+    """Order-insensitive intra-batch late-rescue closure.
+
+    ``anchor`` marks records accepted outright (not hard-late, or
+    rescued by a surviving state cell). A hard-late record is ALSO
+    accepted when a chain of same-key batch records — each consecutive
+    pair < gap apart in event time — links it to an anchor: every such
+    chain is a Flink window merge under SOME arrival order, and a batch
+    is a set of simultaneous arrivals (the framework's watermark is
+    batch-granular already). Because records between an anchor and a
+    rescued record in (key, ts) order are themselves within the chain,
+    the closure is exactly "runs of ts-sorted same-key records with
+    consecutive gaps < gap accept all members iff they contain an
+    anchor" — one lexsort + two segmented OR-scans.
+
+    Returns the accepted mask (over all records; invalid rows False).
+    """
+    b = ts.shape[0]
+    big = jnp.int32(2**31 - 1)
+    perm = jnp.lexsort((ts, jnp.where(mask, keys.astype(jnp.int32), big)))
+    sk = keys.astype(jnp.int32)[perm]
+    sts = ts[perm]
+    sm = mask[perm]
+    sa = anchor[perm]
+    same = (sk[1:] == sk[:-1]) & sm[1:] & sm[:-1]
+    close = (sts[1:] - sts[:-1]) < gap_ms
+    link = jnp.concatenate([jnp.zeros((1,), bool), same & close])
+
+    def comb(a, bb):
+        fa, va = a
+        fb, vb = bb
+        return (fa & fb, jnp.where(fb, va | vb, vb))
+
+    _, fwd = jax.lax.associative_scan(comb, (link, sa))
+    rl = jnp.concatenate([link[1:], jnp.zeros((1,), bool)])
+    _, bwd_r = jax.lax.associative_scan(
+        comb, (jnp.flip(rl), jnp.flip(sa))
+    )
+    acc_sorted = (fwd | jnp.flip(bwd_r)) & sm
+    return jnp.zeros((b,), bool).at[perm].set(acc_sorted, unique_indices=True)
+
+
 def session_retarget(
     acc_leaves: List,
     cnt,
@@ -146,18 +188,25 @@ def session_retarget(
     gap_ms: int,
     ring: RingSpec,
     init_leaves: Sequence,
+    cell_fired=None,
+    lateness_ms: int = 0,
 ):
     """Advance the ring to (hi-N, hi]; stale slots are cleared.
 
-    A stale cell whose session end (``cell_max + gap``) had not yet fired
-    counts toward ``evicted_unfired`` (ring undersized for the session
-    length / lateness horizon).
+    A stale cell still inside its retention horizon (``cell_max + gap - 1
+    + lateness > wm`` — unfired windows before lateness, refire-eligible
+    retained cells within it) counts toward ``evicted_unfired`` (ring
+    undersized for the session length / lateness horizon).
     """
     from .panes import slot_targets
 
     target = slot_targets(hi_pane, ring)
     stale = slot_pane != target              # [N]
-    unfired_cell = stale[None, :] & (cnt > 0) & (cell_max + gap_ms - 1 > wm)
+    unfired_cell = (
+        stale[None, :]
+        & (cnt > 0)
+        & (cell_max + gap_ms - 1 + lateness_ms > wm)
+    )
     evicted = jnp.sum(jnp.where(unfired_cell, cnt, 0)).astype(jnp.int64)
     cnt = jnp.where(stale[None, :], 0, cnt)
     cell_min = jnp.where(stale[None, :], TS_MAX, cell_min)
@@ -166,4 +215,6 @@ def session_retarget(
         jnp.where(stale[None, :], init, a)
         for a, init in zip(acc_leaves, init_leaves)
     ]
-    return acc_leaves, cnt, cell_min, cell_max, target, evicted
+    if cell_fired is not None:
+        cell_fired = jnp.where(stale[None, :], False, cell_fired)
+    return acc_leaves, cnt, cell_min, cell_max, cell_fired, target, evicted

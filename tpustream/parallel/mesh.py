@@ -20,7 +20,16 @@ AXIS = "shards"
 
 
 def make_mesh(n_shards: int, devices: Optional[list] = None) -> jax.sharding.Mesh:
-    devs = list(devices) if devices is not None else list(jax.devices())
+    # sort by (process, id): each host's chips sit contiguously on the
+    # mesh axis, so (a) modulo key ownership keeps most all_to_all
+    # traffic on ICI (DCN only for the cross-host remainder), and (b)
+    # each process's batch rows are one contiguous slice (the multi-host
+    # executor relies on this — Runner._gshard)
+    devs = (
+        list(devices)
+        if devices is not None
+        else sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    )
     if n_shards > len(devs):
         raise RuntimeError(
             f"parallelism {n_shards} exceeds available devices ({len(devs)}); "

@@ -40,7 +40,9 @@ import numpy as np
 # v5: commutative rolling state derives occupancy from a -1-initialized
 # sentinel STR plane — a v4 snapshot's zero-initialized plane would read
 # every key row as already-seen
-FORMAT_VERSION = 5
+# v6: session state gains cell_fired (allowed-lateness retention); count
+# windows gain element-log programs (ebuf/tot)
+FORMAT_VERSION = 6
 _META_KEY = "__meta__"
 
 
@@ -61,6 +63,35 @@ class Checkpoint:
     batches: int
     job_name: Optional[str] = None
     parallelism: int = 1             # mesh shards at snapshot time
+
+    def restore_chain(self, programs):
+        """Restore a runner CHAIN's states: the snapshot's leaf list is
+        the concatenation of each stage's state leaves (saved as a list
+        pytree), split here by each program's own leaf count."""
+        states = []
+        offset = 0
+        for i, prog in enumerate(programs):
+            n = len(jax.tree_util.tree_leaves(prog.init_state()))
+            sub = Checkpoint(
+                leaves=self.leaves[offset : offset + n],
+                record_kinds=self.record_kinds,
+                tables=self.tables,
+                source_pos=self.source_pos,
+                proc_now=self.proc_now,
+                emitted=self.emitted,
+                batches=self.batches,
+                job_name=self.job_name,
+                parallelism=self.parallelism,
+            )
+            states.append(sub.restore_state(prog))
+            offset += n
+        if offset != len(self.leaves):
+            raise ValueError(
+                f"checkpoint has {len(self.leaves)} state arrays but the "
+                f"{len(programs)}-stage chain expects {offset} — job graph "
+                "or config changed since the snapshot"
+            )
+        return states
 
     def restore_state(self, program):
         """Re-place the saved leaves onto ``program``'s init-state shardings.

@@ -274,10 +274,58 @@ class Runner:
         depth = 1 if self.program.emissions_reference_state else cfg.async_depth
         self._max_inflight = max(0, depth - 1)
         self._inflight: List[tuple] = []
+        # -- multi-host (jax.distributed) SPMD --------------------------
+        # every process runs this same executor over the same replayed
+        # source; batch rows are globally sharded (each process donates
+        # its contiguous slice), and each process dispatches only its
+        # own shards' emissions to its local sinks — Flink's
+        # task-manager-local sink semantics (chapter1/README.md:80-84's
+        # n> prefixes, printed on whichever host owns the subtask)
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc:
+            mesh = getattr(self.program, "mesh", None)
+            if mesh is None:
+                raise NotImplementedError(
+                    "multi-host execution needs a sharded program: set "
+                    "StreamConfig.parallelism to the global device count"
+                )
+            if self.program.emissions_reference_state or getattr(
+                self.program, "host_evaluated", False
+            ):
+                raise NotImplementedError(
+                    "full-window process() jobs are not supported across "
+                    "hosts yet (their fires are evaluated against global "
+                    "state on the driving host); use reduce/aggregate"
+                )
+            if cfg.parallelism % jax.process_count():
+                raise ValueError(
+                    f"parallelism ({cfg.parallelism}) must divide evenly "
+                    f"by the process count ({jax.process_count()})"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import AXIS
+
+            self._data_sharding = NamedSharding(mesh, P(AXIS))
+            # place the initial state onto the global mesh (leaves built
+            # host-local would not be addressable under the SPMD step)
+            leaves, treedef = jax.tree_util.tree_flatten(self.state)
+            spec_leaves = jax.tree_util.tree_leaves(
+                self.program.state_specs(self.state),
+                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+            )
+            placed = [
+                jax.device_put(l, NamedSharding(mesh, s))
+                for l, s in zip(leaves, spec_leaves)
+            ]
+            self.state = jax.tree_util.tree_unflatten(treedef, placed)
         # chained stages: emissions feed the downstream runner as
         # columnar batches instead of the sinks (build_plan_chain)
         self.downstream: Optional["Runner"] = None
-        self._chain_buf: List[list] = []
+        self._chain_buf: List[tuple] = []   # (cols, ts_or_None) per step
+        self._chain_rows: List[tuple] = []  # (item, ts) from process() fires
+        self._lazy_plans: List[JobPlan] = []  # stages after a process() stage
+        self._chain_ts = False  # downstream chain contains event-time windows
         self.count_input = True
         # device counter values restored from a checkpoint (finalize
         # subtracts them so a resumed run reports since-resume numbers
@@ -370,6 +418,43 @@ class Runner:
         if self.step is None:
             self.step = self._counted_step(self._inner_step)
 
+    # -- multi-host helpers ---------------------------------------------
+    def _gshard(self, a: np.ndarray):
+        """Assemble a globally sharded [B] input from this process's
+        contiguous row slice (all processes hold the same full batch;
+        each donates its own part — no cross-host data movement)."""
+        procs = jax.process_count()
+        rows = a.shape[0] // procs
+        pi = jax.process_index()
+        return jax.make_array_from_process_local_data(
+            self._data_sharding, a[pi * rows : (pi + 1) * rows], a.shape
+        )
+
+    def _fetch_local(self, tree):
+        """device_get that returns only THIS process's shards of
+        non-fully-addressable leaves (each process dispatches its own
+        shards' emissions)."""
+        def get(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                shards = sorted(
+                    x.addressable_shards, key=lambda s: s.index
+                )
+                return np.concatenate(
+                    [np.asarray(s.data) for s in shards]
+                )
+            return np.asarray(x)
+
+        return jax.tree_util.tree_map(get, tree)
+
+    def _local_row_base(self, local_len: int) -> int:
+        """Global row offset of this process's emission slice (for the
+        per-shard ``order`` indices rolling/count programs emit)."""
+        if not self._multiproc:
+            return 0
+        local_shards = self.program.n_shards // jax.process_count()
+        per_shard = local_len // local_shards
+        return jax.process_index() * local_shards * per_shard
+
     def feed(self, batch: Batch, wm_lower: int, t_batch: Optional[float] = None):
         cfg = self.cfg
         self._check_capacity()
@@ -461,6 +546,13 @@ class Runner:
         """One jitted step + emission dispatch (the only step call site)."""
         self._ensure_step()
         packed, bases, valid, ts_p, ts_b = inputs
+        if self._multiproc:
+            # batch-sized leaves become global arrays (scalars replicate
+            # as plain numpy — identical on every process by replay
+            # determinism)
+            packed = tuple(self._gshard(p) for p in packed)
+            valid = self._gshard(valid)
+            ts_p = self._gshard(ts_p)
         with Stopwatch() as sw:
             self.state, emissions, counts = self.step(
                 self.state, packed, bases, valid, ts_p, ts_b,
@@ -483,37 +575,115 @@ class Runner:
         self.downstream = downstream
         downstream.count_input = False
 
+    def chain(self) -> List["Runner"]:
+        out, r = [], self
+        while r is not None:
+            out.append(r)
+            r = r.downstream
+        return out
+
+    @staticmethod
+    def _downstream_is_event_time(d: "Runner") -> bool:
+        return (
+            getattr(d.program, "domain", None) == TimeCharacteristic.EventTime
+        )
+
+    def _build_lazy_downstream(self) -> "Runner":
+        """Process()-fed chains resolve the downstream record schema from
+        the first collected rows (the user function may emit any shape),
+        then build the remaining runner chain."""
+        # one row suffices for schema inference; the full conversion
+        # happens once, in _rows_to_cols
+        rows = [self._chain_rows[0][0]]
+        _, kinds = run_fallback_map(lambda r: r, rows, self._lazy_plans[0].tables)
+        p2 = self._lazy_plans[0]
+        p2.record_kinds.extend(kinds)
+        d = _make_runner_chain(self._lazy_plans, self.cfg, self.metrics)
+        self._lazy_plans = []
+        self.chain_to(d)
+        _wire_chain_ts(self, d)
+        return d
+
+    def _rows_to_cols(self):
+        """Convert buffered process() rows to the downstream's columnar
+        schema (established at lazy build)."""
+        rows = [item for item, _ in self._chain_rows]
+        ts = (
+            np.asarray([t for _, t in self._chain_rows], dtype=np.int64)
+            if self._chain_ts
+            else None
+        )
+        d = self.downstream
+        cols, _ = run_fallback_map(lambda r: r, rows, d.plan.tables)
+        self._chain_rows = []
+        return cols, ts, d.plan.record_kinds, d.plan.tables
+
     def pump_chain(self, proc_now: int):
         """Move buffered emissions to the downstream runner (or tick its
         processing-time clock when there are none), then cascade."""
         d = self.downstream
+        if d is None and self._chain_rows and self._lazy_plans:
+            d = self._build_lazy_downstream()
         if d is None:
             return
-        if self._chain_buf:
+        fed = False
+        if self._chain_rows:
+            cols, ts, kinds, tables = self._rows_to_cols()
+        elif self._chain_buf:
             bufs, self._chain_buf = self._chain_buf, []
             cols = [
-                np.concatenate([b[i] for b in bufs])
-                for i in range(len(bufs[0]))
+                np.concatenate([b[0][i] for b in bufs])
+                for i in range(len(bufs[0][0]))
             ]
+            ts = (
+                np.concatenate([b[1] for b in bufs])
+                if self._chain_ts
+                else None
+            )
+            kinds, tables = self.program.out_kinds, self.program.out_tables
+        else:
+            cols = []
+        if cols and len(cols[0]):
             n = len(cols[0])
             columns = [
-                Column(k, c, t)
-                for k, c, t in zip(
-                    self.program.out_kinds, cols, self.program.out_tables
-                )
+                Column(k, c, t) for k, c, t in zip(kinds, cols, tables)
             ]
             batch = Batch(
-                n, columns, ts=None,
+                n, columns, ts=ts,
                 proc_ts=np.full(n, proc_now, dtype=np.int64),
             )
-            d.feed(batch, proc_now - 1)
+            # event-time stages let the data drive the watermark; the
+            # processing clock floor belongs to processing-time stages
+            wl = (
+                LONG_MIN + 1
+                if self._downstream_is_event_time(d)
+                else proc_now - 1
+            )
+            d.feed(batch, wl)
             d._last_tick = proc_now
-        elif getattr(d, "_last_tick", None) != proc_now:
+            fed = True
+        if (
+            not fed
+            and getattr(d, "_last_tick", None) != proc_now
+            and not self._downstream_is_event_time(d)
+        ):
             # clock tick, at most once per distinct proc_now: an empty
             # flush step per source batch would double device launches
+            # (event-time stages fire from data/EOS, never the clock)
             d.flush(proc_now - 1)
             d._last_tick = proc_now
         d.pump_chain(proc_now)
+
+    def drain_chain(self, proc_now: int):
+        """Flush every stage's in-flight emissions down the chain (the
+        checkpoint barrier): after this, all emissions of consumed source
+        batches have either reached the sinks or are folded into some
+        stage's device state."""
+        r = self
+        while r is not None:
+            r.drain_inflight()
+            r.pump_chain(proc_now)
+            r = r.downstream
 
     def _finish(self, emissions, counts, t_batch):
         # the blocking waits live here, not in _run_step (dispatch is
@@ -546,7 +716,12 @@ class Runner:
                         stream,
                     )
                 fetch[name] = stream
-            fetched = jax.device_get(fetch) if fetch else {}
+            if not fetch:
+                fetched = {}
+            elif self._multiproc:
+                fetched = self._fetch_local(fetch)
+            else:
+                fetched = jax.device_get(fetch)
         self.metrics.step_times_s.append(sw.elapsed)
         self._dispatch(fetched, t_batch)
 
@@ -608,9 +783,14 @@ class Runner:
             if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 
-    def _emit_row(self, row, subtask):
+    def _emit_row(self, row, subtask, ts=None):
         """Fan one emitted record out to every branch: apply the
-        branch's host-side map/filter tail, then its sink."""
+        branch's host-side map/filter tail, then its sink. Chained
+        process() stages buffer the row (with its window timestamp)
+        for the downstream runner instead."""
+        if self.downstream is not None or self._lazy_plans:
+            self._chain_rows.append((row, ts))
+            return
         for ops, sink in self.sinks:
             item, keep = _apply_ops(ops, row)
             if keep:
@@ -618,12 +798,14 @@ class Runner:
 
     def _dispatch(self, emissions, t_batch=None):
         emitted_before = self.metrics.records_emitted
+        chained = self.downstream is not None or self._lazy_plans
         fire_info = emissions.get("process_fire")
         if fire_info is not None:
             n, fired = self.program.evaluate_fires(
                 self.state, fire_info, self.plan.device_post, self._emit_row
             )
-            self.metrics.records_emitted += n
+            if not chained:
+                self.metrics.records_emitted += n
             self.metrics.window_fires += fired
         main = emissions.get("main")
         if main is not None:
@@ -632,8 +814,10 @@ class Runner:
             if order is not None:
                 # device emitted rows in its internal (sorted) order;
                 # order[j] is arrival row j's position — un-permute HERE,
-                # off the device critical path (numpy gather)
-                order = np.asarray(order)
+                # off the device critical path (numpy gather). Order
+                # values address the GLOBAL stacked buffer; under
+                # multi-host each process fetched only its slice.
+                order = np.asarray(order) - self._local_row_base(mask.shape[0])
                 sel = order[np.nonzero(mask[order])[0]]
             else:
                 sel = np.nonzero(mask)[0]
@@ -641,8 +825,34 @@ class Runner:
                 cols = [np.asarray(c)[sel] for c in main["cols"]]
                 if self.downstream is not None:
                     # chained stage: hand the columnar emissions straight
-                    # to the next runner (no Python rows in between)
-                    self._chain_buf.append(cols)
+                    # to the next runner (no Python rows in between).
+                    # Event timestamps: window results carry end - 1
+                    # (Flink's window result timestamp), rolling
+                    # aggregates forward the record timestamp.
+                    wend = main.get("window_end")
+                    kcol = main.get("key")
+                    if (
+                        wend is not None
+                        and kcol is not None
+                        and self.program.n_shards > 1
+                    ):
+                        # canonical (end, key) order: sharded emission
+                        # buffers stack per shard, which would reorder
+                        # rows of DIFFERENT stage-1 keys that share a
+                        # stage-2 key; the single-chip fire path emits
+                        # end-major then key, so sort to match it
+                        w = np.asarray(wend)[sel]
+                        kk = np.asarray(kcol)[sel]
+                        o = np.lexsort((kk, w))
+                        sel = sel[o]
+                        cols = [c[o] for c in cols]
+                    ts_rows = None
+                    if self._chain_ts:
+                        if wend is not None:
+                            ts_rows = np.asarray(wend)[sel] - 1
+                        else:
+                            ts_rows = np.asarray(main["ts"])[sel]
+                    self._chain_buf.append((cols, ts_rows))
                 else:
                     subtask = main.get("subtask")
                     subtask = (
@@ -679,17 +889,92 @@ class Runner:
                     sink.emit(item)
 
 
+def _chain_needs_event_ts(plans) -> bool:
+    """True when any stage in ``plans`` windows in event time (its input
+    records then need timestamps from the upstream stage)."""
+    for p in plans:
+        st = p.stateful
+        if (
+            st is not None
+            and st.window is not None
+            and st.window.time_domain == TimeCharacteristic.EventTime
+            and st.window.is_time_window()
+        ) or (
+            st is not None
+            and st.window is not None
+            and st.window.kind == "session"
+            and st.window.time_domain == TimeCharacteristic.EventTime
+        ):
+            return True
+    return False
+
+
+def _wire_chain_ts(up: Runner, down: Runner):
+    """Mark ``up`` to extract per-row event timestamps for its chain when
+    any downstream stage windows in event time, and validate the upstream
+    program can provide them."""
+    rest_plans = [r.plan for r in down.chain()]
+    if not _chain_needs_event_ts(rest_plans):
+        return
+    up._chain_ts = True
+    prog = up.program
+    st = up.plan.stateful
+    if st is not None and st.window is not None and st.window.kind == "count":
+        if st.apply_kind != "process":
+            raise NotImplementedError(
+                "count-window results carry no event timestamps (Flink's "
+                "GlobalWindow); window the chained stage in processing "
+                "time, or use a time window upstream"
+            )
+        raise NotImplementedError(
+            "count_window process() results carry no event timestamps; "
+            "window the chained stage in processing time"
+        )
+    if st is not None and st.kind in ("rolling", "rolling_reduce"):
+        prog.emit_ts = True  # read at trace time (first batch)
+
+
 def _make_runner_chain(plans, cfg, metrics) -> Runner:
     """Build the runner for plans[0] plus downstream runners for any
-    chained stages, wiring record schemas from each upstream program."""
+    chained stages, wiring record schemas from each upstream program.
+
+    A stage fed by a full-window process() stage resolves its schema
+    from the user function's first collected rows (the function may emit
+    any shape), so its runner is built lazily on the first pump."""
     runner = Runner(plans[0], cfg, metrics)
     up = runner
-    for p2 in plans[1:]:
+    for i, p2 in enumerate(plans[1:], start=1):
+        if getattr(up.program, "host_evaluated", False):
+            up._lazy_plans = list(plans[i:])
+            up._chain_ts = _chain_needs_event_ts(up._lazy_plans)
+            if up._chain_ts and up.plan.stateful.window is not None and (
+                up.plan.stateful.window.kind == "count"
+            ):
+                raise NotImplementedError(
+                    "count-window results carry no event timestamps "
+                    "(Flink's GlobalWindow); window the chained stage in "
+                    "processing time"
+                )
+            break
         p2.record_kinds.extend(up.program.out_kinds)
         p2.tables.extend(up.program.out_tables)
         r2 = Runner(p2, cfg, metrics)
         up.chain_to(r2)
+        st = up.plan.stateful
+        if st is not None and st.window is not None and (
+            st.window.is_time_window() or st.window.kind == "session"
+        ):
+            # emit the key column so the chain glue can impose the
+            # canonical (end, key) order across shards (read at trace
+            # time — the program jits on its first batch)
+            up.program.emit_chain_key = True
         up = r2
+    # wire ts extraction only once the FULL chain exists: whether stage i
+    # must extract timestamps depends on every stage after it
+    r = runner
+    while r is not None and r.downstream is not None:
+        _wire_chain_ts(r, r.downstream)
+        r = r.downstream
     return runner
 
 
@@ -698,22 +983,27 @@ def execute_job(env, sink_nodes) -> JobResult:
     plans = build_plan_chain(env, sink_nodes)
     plan = plans[0]
     chained = len(plans) > 1
-    if chained:
-        if cfg.parallelism > 1:
-            raise NotImplementedError(
-                "chained keyed stages run single-chip for now "
-                "(parallelism must be 1)"
-            )
+    if jax.process_count() > 1:
         if cfg.checkpoint_dir:
             raise NotImplementedError(
-                "checkpointing across chained keyed stages is not "
-                "supported yet"
+                "checkpointing is not supported across hosts yet; snapshot "
+                "from a single-host run"
             )
+        if chained:
+            raise NotImplementedError(
+                "chained keyed stages are not supported across hosts yet "
+                "(stage hand-off re-batches host-side per process)"
+            )
+    if chained and cfg.checkpoint_dir:
+        # the downstream schema of a process()-fed stage is resolved
+        # adaptively from user-collected rows; snapshotting that
+        # adaptive schema is not supported (every other chain shape is)
         for p in plans[:-1]:
             if p.stateful is not None and p.stateful.apply_kind == "process":
                 raise NotImplementedError(
-                    "chaining after a full-window process() stage is not "
-                    "supported (its emissions are host-evaluated rows)"
+                    "checkpointing a chain fed by a full-window process() "
+                    "stage is not supported (its record schema is "
+                    "resolved adaptively from collected rows)"
                 )
     host = HostStage(plan, cfg)
     metrics = Metrics()
@@ -729,9 +1019,12 @@ def execute_job(env, sink_nodes) -> JobResult:
 
         ck = load_checkpoint(restore_path)
         ck.restore_tables(plan)
-        runner = Runner(plan, cfg, metrics)
-        runner.state = ck.restore_state(runner.program)
-        runner.snapshot_counter_baseline()
+        runner = _make_runner_chain(plans, cfg, metrics)
+        stages = runner.chain()
+        states = ck.restore_chain([r.program for r in stages])
+        for r, s in zip(stages, states):
+            r.state = s
+            r.snapshot_counter_baseline()
         skip_lines = ck.source_pos
         proc_now = ck.proc_now
     lines_consumed = skip_lines
@@ -811,11 +1104,16 @@ def execute_job(env, sink_nodes) -> JobResult:
 
             # emissions still in flight belong to pre-snapshot batches;
             # a resume replays only post-snapshot lines, so flush them
-            # to the sinks before the state is captured
-            runner.drain_inflight()
+            # down the whole chain before the states are captured
+            runner.drain_chain(proc_now)
+            stages = runner.chain()
             save_checkpoint(
                 cfg.checkpoint_dir,
-                state=runner.state,
+                state=(
+                    [r.state for r in stages]
+                    if len(stages) > 1
+                    else runner.state
+                ),
                 plan=plan,
                 source_pos=lines_consumed,
                 proc_now=proc_now,
@@ -838,12 +1136,15 @@ def execute_job(env, sink_nodes) -> JobResult:
         runner.drain_inflight()
         # chained stages: push the final emissions down the chain, then
         # fire EVERYTHING still windowed (Flink's end-of-input MAX
-        # watermark) — the chain's processing-time stamps are synthetic
-        # arrival times, and nothing more can arrive after EOS
+        # watermark) — nothing more can arrive after EOS. pump_chain may
+        # BUILD a process()-fed stage here (lazy schema), so re-check
+        # downstream after each pump.
         r = runner
-        while r.downstream is not None:
+        while True:
             r.pump_chain(proc_now)
             d = r.downstream
+            if d is None:
+                break
             d.flush(MAX_WATERMARK)
             d.drain_inflight()
             r = d

@@ -88,6 +88,11 @@ class JobPlan:
     # these nodes form the NEXT stage's plan, fed by this stage's
     # compacted emissions (see build_plan_chain)
     chain_rest: List[Node] = field(default_factory=list)
+    # chained stages: event timestamps arrive WITH the upstream emissions
+    # (window results carry window_end - 1, Flink's result timestamp;
+    # rolling aggregates forward the record's own timestamp), so
+    # event-time windows need no assigner here
+    upstream_supplies_ts: bool = False
 
 
 def _is_raw_stage(kinds: Optional[List[str]]) -> bool:
@@ -385,12 +390,6 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
         if op in ("window_reduce", "window_aggregate", "window_process"):
             assert pending_window is not None
             spec: WindowSpec = pending_window.params["spec"]
-            if spec.time_domain == TimeCharacteristic.EventTime:
-                raise NotImplementedError(
-                    "chained stages run windows in PROCESSING time only: "
-                    "upstream emissions carry no event timestamps (set "
-                    "ProcessingTime, or window before the re-key)"
-                )
             stateful = StatefulSpec(
                 "window",
                 window=spec,
@@ -426,6 +425,7 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
         device_post=device_post,
         branches=[],
         side_outputs=[],
-        time_characteristic=TimeCharacteristic.ProcessingTime,
+        time_characteristic=env.time_characteristic,
         chain_rest=chain_rest,
+        upstream_supplies_ts=True,
     )

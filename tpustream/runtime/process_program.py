@@ -335,6 +335,8 @@ class ProcessWindowProgram(WindowProgram):
                 for item in out.items:
                     item, keep = run_post_ops(item, post_ops)
                     if keep:
-                        emit(item, key_id % S)
+                        # third arg: Flink's window result timestamp
+                        # (end - 1), consumed by chained stages
+                        emit(item, key_id % S, int(ends[j]) - 1)
                         emitted += 1
         return emitted, fired

@@ -12,11 +12,22 @@ user accumulator plus min/max record timestamps; sessions are maximal
 linked runs reduced by segmented scans over the pane axis; a run fires
 when ``run_max_ts + gap - 1 <= watermark`` and its cells are cleared.
 
-Late records (``ts + gap - 1 <= watermark`` on arrival) are dropped to
-the late side output. This matches Flink except the corner where a late
-record would have merged into a still-open earlier session; sessions
-with ``allowed_lateness > 0`` are not supported (the reference only
-documents lateness for time windows, chapter3/README.md:209-228).
+Late handling matches Flink's merging-window operator exactly
+(chapter3/README.md:195-228 semantics applied to sessions):
+
+* A record is dropped to the late side output only when its MERGED
+  window would be late — i.e. its solo window ``[ts, ts+gap)`` is past
+  ``watermark + allowed_lateness`` AND it overlaps no surviving session
+  cell (surviving cells are, by construction, within their retention
+  horizon). A "late" record that bridges still-open sessions is
+  accepted and merges them, as Flink's ``mergingWindows.addWindow``
+  does.
+* With ``allowed_lateness > 0`` fired sessions are RETAINED (cells
+  marked fired, not cleared) until ``end - 1 + lateness`` passes the
+  watermark; a late record landing in or next to a retained session
+  re-fires the merged session with its updated accumulator (Flink's
+  late firing). Runs fire only when they contain an unfired/dirty
+  cell, so retained sessions do not re-fire spuriously.
 """
 
 from __future__ import annotations
@@ -46,23 +57,18 @@ class SessionWindowProgram(WindowProgram):
                 "session windows currently support reduce/aggregate window "
                 "functions (the surface the reference documents)"
             )
-        if st.allowed_lateness_ms > 0:
-            raise NotImplementedError(
-                "allowed lateness on session windows is not supported; the "
-                "reference documents lateness for time windows only "
-                "(chapter3/README.md:209-228)"
-            )
         super().__init__(plan, cfg)
 
     # WindowProgram.__init__ builds the ring from spec.size/slide; give it
     # a session-shaped ring instead: panes of gap ms, 1 pane per "window",
-    # extra slack so multi-pane sessions have room to grow.
+    # extra slack so multi-pane sessions have room to grow (and retained
+    # fired sessions have coverage through the lateness horizon).
     def _make_ring(self, spec, cfg):
         return pane_ops.make_ring_spec(
             spec.gap_ms,
             spec.gap_ms,
             self.delay_ms,
-            0,
+            self.allowed_lateness_ms,
             cfg.pane_ring_slack + cfg.session_extra_panes,
         )
 
@@ -92,6 +98,10 @@ class SessionWindowProgram(WindowProgram):
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
             "cell_min": jnp.full((k, n), TS_MAX, dtype=jnp.int64),
             "cell_max": jnp.full((k, n), W0, dtype=jnp.int64),
+            # True on cells of sessions that already fired and are
+            # retained for allowed-lateness refires; a record landing in
+            # (or merging with) such a cell resets it to dirty
+            "cell_fired": jnp.zeros((k, n), dtype=bool),
             "window_fires": jnp.zeros((), dtype=jnp.int64),
             "late_dropped": jnp.zeros((), dtype=jnp.int64),
         }
@@ -105,8 +115,10 @@ class SessionWindowProgram(WindowProgram):
 
     # ------------------------------------------------------------------
     def _scatter_session(self, state, keys, mid_cols, live, pane, ts):
-        """WindowProgram's tail-scatter, extended with two per-cell
-        min/max record-timestamp leaves (session boundary detection)."""
+        """WindowProgram's tail-scatter, extended with per-cell min/max
+        record-timestamp leaves (session boundary detection) and the
+        fired flag (a cell receiving any record goes dirty, so retained
+        sessions become refire-eligible)."""
         n_user = len(state["acc"])
 
         def combine_ext(a, b):
@@ -114,19 +126,32 @@ class SessionWindowProgram(WindowProgram):
             return tuple(ua) + (
                 jnp.minimum(a[n_user], b[n_user]),
                 jnp.maximum(a[n_user + 1], b[n_user + 1]),
+                jnp.logical_and(a[n_user + 2], b[n_user + 2]),
             )
 
-        batch_leaves = tuple(self.lift(list(mid_cols))) + (ts, ts)
-        leaves = list(state["acc"]) + [state["cell_min"], state["cell_max"]]
+        batch_leaves = tuple(self.lift(list(mid_cols))) + (
+            ts, ts, jnp.zeros_like(live),
+        )
+        leaves = list(state["acc"]) + [
+            state["cell_min"], state["cell_max"], state["cell_fired"],
+        ]
         written, new_cnt, _, _ = self._scatter_cells(
             leaves, state["cnt"], keys, batch_leaves, live, pane, combine_ext
         )
-        return written[:-2], new_cnt, written[-2], written[-1]
+        return written[:-3], new_cnt, written[-3], written[-2], written[-1]
 
     # ------------------------------------------------------------------
-    def _fire_sessions(self, acc, cnt, cell_min, cell_max, slot_pane, hi, wm):
-        """Fire every completed session: returns (emit_valid, emit_cols,
-        overflow, clear_mask [K, N] in slot order)."""
+    def _fire_sessions(
+        self, acc, cnt, cell_min, cell_max, cell_fired, slot_pane, hi, wm
+    ):
+        """Fire every completed DIRTY session (one with at least one
+        unfired cell — a never-fired run, or a retained run a late
+        record re-dirtied): returns (emit_valid, emit_cols, overflow,
+        clear_mask, mark_mask [K, N] in slot order, n_fired).
+
+        ``clear_mask`` removes runs past their lateness retention
+        horizon; ``mark_mask`` flags the cells of runs fired this step
+        (with lateness 0 the two coincide and marking is moot)."""
         ring = self.ring
         k, n = self.local_key_capacity, ring.n_slots
         cap = self.cfg.alert_capacity
@@ -139,7 +164,28 @@ class SessionWindowProgram(WindowProgram):
         mn = jnp.where(occ, cell_min[:, slot], TS_MAX)
         mx = jnp.where(occ, cell_max[:, slot], W0)
         link, run_end = sess_ops.session_runs(occ, mn, mx, self.gap_ms)
-        fire = run_end & (mx + self.gap_ms - 1 <= wm)
+        # per-run count of dirty (unfired) cells, via a segmented sum
+        # along the pane axis — cheap relative to the accumulator scan,
+        # and it gates that scan: retained (all-fired) runs cross the
+        # watermark every step but must not re-fire or pay do_fire
+        unf = (occ & ~cell_fired[:, slot]).astype(jnp.int32)
+        (run_unf_o,) = sess_ops.seg_scan_axis0(
+            [jnp.moveaxis(unf, 1, 0)],
+            jnp.moveaxis(link, 1, 0),
+            lambda a, b: (a[0] + b[0],),
+        )
+        run_unf = jnp.moveaxis(run_unf_o, 0, 1)            # [K, O]
+        crossed = run_end & (mx + self.gap_ms - 1 <= wm)
+        fire = crossed & (run_unf > 0)
+        cleanup = run_end & (
+            mx + self.gap_ms - 1 + self.allowed_lateness_ms <= wm
+        )
+        # slot-order rotation shared by both masks
+        inv = jnp.mod(
+            jnp.arange(n, dtype=jnp.int64) - (hi + 1), n
+        ).astype(jnp.int32)
+        clear_mask = sess_ops.propagate_to_run(cleanup, link)[:, inv]
+        mark_mask = sess_ops.propagate_to_run(fire, link)[:, inv]
         any_fire = jnp.any(fire)
 
         def do_fire(_):
@@ -181,15 +227,9 @@ class SessionWindowProgram(WindowProgram):
                 post_mask & fvalid, post_cols + [key_col, end_col], cap
             )
             overflow = fire_ovf + alert_ovf
-            cleared = sess_ops.propagate_to_run(fire, link)  # [K, O]
-            # back to slot order: slot axis is a cyclic rotation of panes
-            inv = jnp.mod(
-                jnp.arange(n, dtype=jnp.int64) - (hi + 1), n
-            ).astype(jnp.int32)
-            clear_mask = cleared[:, inv]
             # one fire per (key, session) with content, pre post-filter
             n_fired = jnp.sum(emit_mask).astype(jnp.int64)
-            return valid, out, overflow, clear_mask, n_fired
+            return valid, out, overflow, n_fired
 
         def no_fire(_):
             v = lambda x: pane_ops.vary(x, self.vary_axes)
@@ -205,11 +245,13 @@ class SessionWindowProgram(WindowProgram):
                     v(jnp.zeros((cap,), dtype=jnp.int64)),
                 ],
                 v(jnp.zeros((), dtype=jnp.int64)),
-                v(jnp.zeros((k, n), dtype=bool)),
                 v(jnp.zeros((), dtype=jnp.int64)),
             )
 
-        return jax.lax.cond(any_fire, do_fire, no_fire, operand=None)
+        valid, out, overflow, n_fired = jax.lax.cond(
+            any_fire, do_fire, no_fire, operand=None
+        )
+        return valid, out, overflow, clear_mask, mark_mask, n_fired
 
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
@@ -226,11 +268,41 @@ class SessionWindowProgram(WindowProgram):
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
         keys = self._local_keys(mid_cols[self.key_pos])
 
-        # a record whose solo session has already closed is late
-        late = (ts + self.gap_ms - 1 <= wm_old) & mask
-        live = mask & ~late
-
+        # Flink's merging-window lateness test: a record is late only if
+        # its MERGED window would be late — solo window past the
+        # retention horizon AND no overlap with a surviving session cell
+        # (cells live in panes of exactly gap ms, so only panes p-1/p/p+1
+        # can overlap the solo window [ts, ts+gap))
+        n_slots_ = ring.n_slots
+        gap = self.gap_ms
         pane = pane_ops.pane_of(ts, ring.pane_ms)
+        hard_late = (ts + gap - 1 + self.allowed_lateness_ms <= wm_old) & mask
+
+        def _mergeable(q):
+            s = jnp.mod(q, n_slots_).astype(jnp.int32)
+            flat = keys.astype(jnp.int64) * n_slots_ + s
+            occ_q = (state["slot_pane"][s] == q) & (
+                state["cnt"].reshape(-1)[flat] > 0
+            )
+            mn_q = state["cell_min"].reshape(-1)[flat]
+            mx_q = state["cell_max"].reshape(-1)[flat]
+            return occ_q & (mn_q < ts + gap) & (ts < mx_q + gap)
+
+        rescued = _mergeable(pane - 1) | _mergeable(pane) | _mergeable(pane + 1)
+        # intra-batch rescue: a hard-late record may also merge into a
+        # session another record of this SAME batch opens (the batch is
+        # a set of simultaneous arrivals) — closure over ts-chains
+        anchor = mask & (~hard_late | rescued)
+        accepted = jax.lax.cond(
+            jnp.any(hard_late & ~rescued),
+            lambda _: sess_ops.batch_rescue_closure(
+                keys, ts, mask, anchor, gap
+            ),
+            lambda _: anchor,
+            operand=None,
+        )
+        late = mask & ~accepted
+        live = mask & ~late
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
 
@@ -248,6 +320,8 @@ class SessionWindowProgram(WindowProgram):
                 state["acc"], state["cnt"], state["cell_min"],
                 state["cell_max"], state["slot_pane"], hi, wm_old,
                 self.gap_ms, ring, init_leaves,
+                cell_fired=state["cell_fired"],
+                lateness_ms=self.allowed_lateness_ms,
             )
 
         def skip_retarget(_):
@@ -256,21 +330,30 @@ class SessionWindowProgram(WindowProgram):
                 state["cnt"],
                 state["cell_min"],
                 state["cell_max"],
+                state["cell_fired"],
                 state["slot_pane"],
                 pane_ops.vary(jnp.zeros((), dtype=jnp.int64), self.vary_axes),
             )
 
-        acc, cnt, cmin, cmax, slot_pane, evicted = jax.lax.cond(
+        acc, cnt, cmin, cmax, cfired, slot_pane, evicted = jax.lax.cond(
             hi > state["hi"], do_retarget, skip_retarget, operand=None
         )
-        acc, cnt, cmin, cmax = self._scatter_session(
-            {"acc": acc, "cnt": cnt, "cell_min": cmin, "cell_max": cmax},
+        acc, cnt, cmin, cmax, cfired = self._scatter_session(
+            {
+                "acc": acc, "cnt": cnt, "cell_min": cmin, "cell_max": cmax,
+                "cell_fired": cfired,
+            },
             keys, mid_cols, live, pane, ts,
         )
 
-        emit_valid, emit_cols, overflow, clear, n_fired = self._fire_sessions(
-            acc, cnt, cmin, cmax, slot_pane, hi, wm_new
+        (
+            emit_valid, emit_cols, overflow, clear, mark, n_fired,
+        ) = self._fire_sessions(
+            acc, cnt, cmin, cmax, cfired, slot_pane, hi, wm_new
         )
+        # mark fired runs retained, then clear runs past their horizon
+        # (with lateness 0 the masks coincide and clearing wins)
+        cfired = jnp.where(clear, False, cfired | mark)
         cnt = jnp.where(clear, 0, cnt)
         cmin = jnp.where(clear, TS_MAX, cmin)
         cmax = jnp.where(clear, W0, cmax)
@@ -285,6 +368,7 @@ class SessionWindowProgram(WindowProgram):
             "cnt": cnt,
             "cell_min": cmin,
             "cell_max": cmax,
+            "cell_fired": cfired,
             "slot_pane": slot_pane,
             "hi": hi,
             "wm": wm_new,
@@ -306,13 +390,16 @@ class SessionWindowProgram(WindowProgram):
                 else 0
             ),
         }
+        main = {
+            "mask": emit_valid,
+            "cols": tuple(emit_cols[:-2]),
+            "subtask": key_out % n_shards,
+            "window_end": emit_cols[-1],
+        }
+        if getattr(self, "emit_chain_key", False):
+            main["key"] = key_out  # chained stages: canonical order
         emissions = {
-            "main": {
-                "mask": emit_valid,
-                "cols": tuple(emit_cols[:-2]),
-                "subtask": key_out % n_shards,
-                "window_end": emit_cols[-1],
-            },
+            "main": main,
             "late": {"mask": late, "cols": tuple(mid_cols)},
         }
         return new_state, emissions
@@ -324,35 +411,29 @@ class SessionProcessProgram(ProcessWindowProgram):
     Element buffers follow ProcessWindowProgram's [keys, slots, cap]
     layout; session boundaries follow SessionWindowProgram's per-cell
     min/max-timestamp run detection (gap panes, only adjacent panes can
-    merge). Fires are EDGE-TRIGGERED — a run fires on the step whose
-    watermark first passes ``run_max + gap - 1`` — and the fired run's
-    cells are cleared at the START of the next step, because the host
-    gathers the fired elements from post-step state in between
+    merge). A run fires when the watermark has passed ``run_max + gap -
+    1`` AND the run holds at least one unfired (dirty) cell — a
+    never-fired session, or a retained one a late record re-dirtied
+    under allowed lateness. Fired runs are MARKED (``pending_mark``) and
+    horizon-passed runs scheduled for clearing (``pending_clear``) at
+    the START of the next step, because the host gathers the fired
+    elements from post-step state in between
     (``emissions_reference_state`` keeps the executor synchronous).
 
     Reference surface: session windows (chapter3/README.md:412-428) x
-    ProcessWindowFunction (chapter2/README.md:177-196). Allowed lateness
-    on sessions stays unsupported, like the reduce/aggregate program.
+    ProcessWindowFunction (chapter2/README.md:177-196) x allowed
+    lateness (:209-228), with the same Flink-exact merged-window late
+    test as SessionWindowProgram.
     """
 
     accepted_kinds = ("session",)
-
-    def __init__(self, plan: JobPlan, cfg):
-        st = plan.stateful
-        if st.allowed_lateness_ms > 0:
-            raise NotImplementedError(
-                "allowed lateness on session windows is not supported; the "
-                "reference documents lateness for time windows only "
-                "(chapter3/README.md:209-228)"
-            )
-        super().__init__(plan, cfg)
 
     def _make_ring(self, spec, cfg):
         return pane_ops.make_ring_spec(
             spec.gap_ms,
             spec.gap_ms,
             self.delay_ms,
-            0,
+            self.allowed_lateness_ms,
             cfg.pane_ring_slack + cfg.session_extra_panes,
         )
 
@@ -365,6 +446,8 @@ class SessionProcessProgram(ProcessWindowProgram):
         k, n = self.cfg.key_capacity, self.ring.n_slots
         s["cell_min"] = jnp.full((k, n), TS_MAX, dtype=jnp.int64)
         s["cell_max"] = jnp.full((k, n), W0, dtype=jnp.int64)
+        s["cell_fired"] = jnp.zeros((k, n), dtype=bool)
+        s["pending_mark"] = jnp.zeros((k, n), dtype=bool)
         s["pending_clear"] = jnp.zeros((k, n), dtype=bool)
         return s
 
@@ -372,6 +455,7 @@ class SessionProcessProgram(ProcessWindowProgram):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
         ring = self.ring
         n, gap = ring.n_slots, self.gap_ms
+        L = self.allowed_lateness_ms
 
         wm_old = state["wm"]
         batch_max = self._global_max(jnp.max(jnp.where(mask, ts, W0)))
@@ -384,31 +468,61 @@ class SessionProcessProgram(ProcessWindowProgram):
         keys = self._local_keys(mid_cols[self.key_pos])
         k = state["cnt"].shape[0]
 
-        late = (ts + gap - 1 <= wm_old) & mask
+        # ---- apply the PREVIOUS step's marks and clears ------------------
+        # (the host consumed those fired buffers between steps)
+        pm, pc = state["pending_mark"], state["pending_clear"]
+        cfired0 = jnp.where(pc, False, state["cell_fired"] | pm)
+        cnt0 = jnp.where(pc, 0, state["cnt"])
+        cmin0 = jnp.where(pc, TS_MAX, state["cell_min"])
+        cmax0 = jnp.where(pc, W0, state["cell_max"])
+
+        # ---- Flink's merged-window late test (see SessionWindowProgram):
+        # drop only when the solo window is past the horizon AND no
+        # surviving cell in panes p-1/p/p+1 overlaps it
+        pane = pane_ops.pane_of(ts, ring.pane_ms)
+        hard_late = (ts + gap - 1 + L <= wm_old) & mask
+
+        def _mergeable(q):
+            s = jnp.mod(q, n).astype(jnp.int32)
+            flat = keys.astype(jnp.int64) * n + s
+            occ_q = (state["slot_pane"][s] == q) & (
+                cnt0.reshape(-1)[flat] > 0
+            )
+            mn_q = cmin0.reshape(-1)[flat]
+            mx_q = cmax0.reshape(-1)[flat]
+            return occ_q & (mn_q < ts + gap) & (ts < mx_q + gap)
+
+        rescued = _mergeable(pane - 1) | _mergeable(pane) | _mergeable(pane + 1)
+        # intra-batch rescue closure (see SessionWindowProgram._step)
+        anchor = mask & (~hard_late | rescued)
+        accepted = jax.lax.cond(
+            jnp.any(hard_late & ~rescued),
+            lambda _: sess_ops.batch_rescue_closure(
+                keys, ts, mask, anchor, gap
+            ),
+            lambda _: anchor,
+            operand=None,
+        )
+        late = mask & ~accepted
         live = mask & ~late
 
-        pane = pane_ops.pane_of(ts, ring.pane_ms)
         batch_hi = self._global_max(jnp.max(jnp.where(live, pane, -1)))
         hi = jnp.maximum(state["hi"], batch_hi)
         uncov = live & (pane <= hi - n)
         live = live & ~uncov
         n_uncov = self._global_sum(jnp.sum(uncov).astype(jnp.int64))
 
-        # ---- apply the PREVIOUS step's fired-run clears ------------------
-        # (the host consumed those buffers between steps)
-        pc = state["pending_clear"]
-        cnt0 = jnp.where(pc, 0, state["cnt"])
-        cmin0 = jnp.where(pc, TS_MAX, state["cell_min"])
-        cmax0 = jnp.where(pc, W0, state["cell_max"])
-
         # ---- retarget ----------------------------------------------------
         target = pane_ops.slot_targets(hi, ring)
         stale = state["slot_pane"] != target
-        unfired_cell = stale[None, :] & (cnt0 > 0) & (cmax0 + gap - 1 > wm_old)
+        unfired_cell = (
+            stale[None, :] & (cnt0 > 0) & (cmax0 + gap - 1 + L > wm_old)
+        )
         evicted = jnp.sum(jnp.where(unfired_cell, cnt0, 0)).astype(jnp.int64)
         cnt = jnp.where(stale[None, :], 0, cnt0)
         cmin = jnp.where(stale[None, :], TS_MAX, cmin0)
         cmax = jnp.where(stale[None, :], W0, cmax0)
+        cfired = jnp.where(stale[None, :], False, cfired0)
         buf = state["buf"]
         slot_pane = target
 
@@ -429,8 +543,15 @@ class SessionProcessProgram(ProcessWindowProgram):
             .max(ts, mode="drop")
             .reshape(k, n)
         )
+        # a cell that received records goes dirty (refire-eligible)
+        cfired = (
+            cfired.reshape(-1)
+            .at[live_cell]
+            .set(False, mode="drop")
+            .reshape(k, n)
+        )
 
-        # ---- session runs + edge-triggered fires -------------------------
+        # ---- session runs + dirty-gated fires ----------------------------
         slot_o, pane_ids = sess_ops.ascending_slot_order(hi, ring)
         occ = (slot_pane[slot_o][None, :] == pane_ids[None, :]) & (
             cnt[:, slot_o] > 0
@@ -438,14 +559,20 @@ class SessionProcessProgram(ProcessWindowProgram):
         mn = jnp.where(occ, cmin[:, slot_o], TS_MAX)
         mx = jnp.where(occ, cmax[:, slot_o], W0)
         link, run_end = sess_ops.session_runs(occ, mn, mx, gap)
-        fire = (
-            run_end & (mx + gap - 1 <= wm_new) & (mx + gap - 1 > wm_old)
+        unf = (occ & ~cfired[:, slot_o]).astype(jnp.int32)
+        (run_unf_o,) = sess_ops.seg_scan_axis0(
+            [jnp.moveaxis(unf, 1, 0)],
+            jnp.moveaxis(link, 1, 0),
+            lambda a, b: (a[0] + b[0],),
         )
-        cleared_o = sess_ops.propagate_to_run(fire, link)
+        run_unf = jnp.moveaxis(run_unf_o, 0, 1)
+        fire = run_end & (mx + gap - 1 <= wm_new) & (run_unf > 0)
+        cleanup = run_end & (mx + gap - 1 + L <= wm_new)
         inv = jnp.mod(
             jnp.arange(n, dtype=jnp.int64) - (hi + 1), n
         ).astype(jnp.int32)
-        pending_clear = cleared_o[:, inv]
+        pending_mark = sess_ops.propagate_to_run(fire, link)[:, inv]
+        pending_clear = sess_ops.propagate_to_run(cleanup, link)[:, inv]
         n_fired = jnp.sum(fire).astype(jnp.int64)
 
         new_state = {
@@ -457,6 +584,8 @@ class SessionProcessProgram(ProcessWindowProgram):
             "max_ts": new_max,
             "cell_min": cmin,
             "cell_max": cmax,
+            "cell_fired": cfired,
+            "pending_mark": pending_mark,
             "pending_clear": pending_clear,
             "evicted_unfired": state["evicted_unfired"]
             + self._global_sum(evicted)
@@ -483,7 +612,7 @@ class SessionProcessProgram(ProcessWindowProgram):
 
     # ------------------------------------------------------------------
     def evaluate_fires(self, state, fire_info, post_ops, emit):
-        """Host callback: the fired cells are ``state["pending_clear"]``
+        """Host callback: the fired cells are ``state["pending_mark"]``
         (the device's decision — no fire predicate is re-derived), split
         into individual sessions with the SAME boundary predicate the
         device uses (sess_ops.session_links with numpy): two fired
@@ -491,12 +620,18 @@ class SessionProcessProgram(ProcessWindowProgram):
         are gap..2*gap-1 apart, so mere pane contiguity is not enough.
         Runs the user ProcessWindowFunction over each run's buffered
         elements in pane order; Flink's session TimeWindow is
-        [min_ts, max_ts + gap)."""
-        if int(np.asarray(fire_info["fire"]).reshape(-1)[0]) == 0:
+        [min_ts, max_ts + gap).
+
+        Sharded layout: state leaves assemble shard-major (row =
+        shard * local_keys + local_row holds global key ``local_row *
+        n_shards + shard``); per-shard ``fire`` counts sum."""
+        if int(np.asarray(fire_info["fire"]).reshape(-1).sum()) == 0:
             return 0, 0
         ring = self.ring
         n, gap = ring.n_slots, self.gap_ms
         cap = self.cfg.process_buffer_capacity
+        S = max(1, self.n_shards)
+        k_local = self.local_key_capacity
         wm = int(np.asarray(fire_info["wm"]).reshape(-1)[0])
         cnt = np.asarray(state["cnt"])
         cmin = np.asarray(state["cell_min"])
@@ -509,7 +644,7 @@ class SessionProcessProgram(ProcessWindowProgram):
         o = np.arange(n, dtype=np.int64)
         pane_ids = hi - n + 1 + o
         slot_o = (pane_ids % n).astype(np.int64)
-        cleared = np.asarray(state["pending_clear"])[:, slot_o]
+        cleared = np.asarray(state["pending_mark"])[:, slot_o]
         mn = np.where(cleared, cmin[:, slot_o], TS_MAX)
         mx = np.where(cleared, cmax[:, slot_o], W0)
         link = sess_ops.session_links(cleared, mn, mx, gap, xp=np)
@@ -539,7 +674,7 @@ class SessionProcessProgram(ProcessWindowProgram):
                         elements.append(
                             vals[0] if len(vals) == 1 else make_tuple(*vals)
                         )
-                key_id = int(key_row)
+                key_id = int(key_row % k_local) * S + int(key_row // k_local)
                 key_val = (
                     key_table.lookup(key_id)
                     if key_table is not None
@@ -552,6 +687,9 @@ class SessionProcessProgram(ProcessWindowProgram):
                 for item in out.items:
                     item, keep = run_post_ops(item, post_ops)
                     if keep:
-                        emit(item, key_id % max(1, self.n_shards))
+                        # session result timestamp = end - 1 (Flink),
+                        # consumed by chained stages
+                        emit(item, key_id % max(1, self.n_shards),
+                             end_ts + gap - 1)
                         emitted += 1
         return emitted, fired

@@ -28,7 +28,7 @@ from .count_program import (
 )
 from .plan import JobPlan
 from .process_program import ProcessWindowProgram
-from .session_program import SessionWindowProgram
+from .session_program import SessionProcessProgram, SessionWindowProgram
 from .step import RollingProgram
 from .window_program import WindowProgram
 
@@ -116,6 +116,21 @@ class ShardedWindowProgram(_ShardedMixin, WindowProgram):
 
 
 class ShardedSessionWindowProgram(_ShardedMixin, SessionWindowProgram):
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit()
+
+
+class ShardedSessionProcessProgram(_ShardedMixin, SessionProcessProgram):
+    """Session windows + ProcessWindowFunction at parallelism N: the
+    keyBy exchange routes records to their owner shard, element buffers
+    and per-cell session metadata shard on the key axis, and the host
+    callback maps shard-major state rows back to global key ids
+    (closing round 2's last single-chip-only program shape)."""
+
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         self._setup_sharding(cfg)

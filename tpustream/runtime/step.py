@@ -253,14 +253,20 @@ class RollingProgram(BaseProgram):
         subtask = self._global_key_ids(
             jnp.where(sv, sk, 0).astype(jnp.int32)
         ) % n_shards
-        return new_state, {
-            "main": {
-                "mask": out_mask,
-                "cols": tuple(out_cols),
-                "subtask": subtask,
-                "order": self._row_offset(inv.shape[0]) + inv.astype(jnp.int32),
-            }
+        main = {
+            "mask": out_mask,
+            "cols": tuple(out_cols),
+            "subtask": subtask,
+            "order": self._row_offset(inv.shape[0]) + inv.astype(jnp.int32),
         }
+        if getattr(self, "emit_ts", False):
+            # chained stages with event-time windows downstream: a
+            # rolling aggregate forwards the input record's timestamp
+            # (Flink's per-record emission keeps the element timestamp)
+            from ..ops.segments import inverse_permutation
+
+            main["ts"] = ts[inverse_permutation(inv)]
+        return new_state, {"main": main}
 
 
 def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
@@ -303,11 +309,9 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
         if plan.stateful.window is not None and plan.stateful.window.kind == "session":
             if plan.stateful.apply_kind == "process":
                 if sharded:
-                    raise NotImplementedError(
-                        "sharded session windows with a "
-                        "ProcessWindowFunction are not supported yet; run "
-                        "at parallelism 1 or use reduce/aggregate"
-                    )
+                    from .sharded import ShardedSessionProcessProgram
+
+                    return ShardedSessionProcessProgram(plan, cfg)
                 from .session_program import SessionProcessProgram
 
                 return SessionProcessProgram(plan, cfg)

@@ -82,6 +82,7 @@ class WindowProgram(BaseProgram):
             spec.time_domain == TimeCharacteristic.EventTime
             and plan.time_characteristic == TimeCharacteristic.EventTime
             and plan.ts_assigner is None
+            and not plan.upstream_supplies_ts
         ):
             raise RuntimeError(
                 "event-time windows need assign_timestamps_and_watermarks "
@@ -958,13 +959,21 @@ class WindowProgram(BaseProgram):
                 else 0
             ),
         }
+        main = {
+            "mask": emit_valid,
+            "cols": tuple(emit_cols[:-2]),
+            "subtask": key_out % n_shards,
+            "window_end": emit_cols[-1],
+        }
+        if getattr(self, "emit_chain_key", False):
+            # chained stages only (set by the executor before trace):
+            # key + end give the chain glue a canonical cross-shard
+            # order matching the single-chip fire order (end-major,
+            # then key — see Runner._dispatch). Unchained jobs skip the
+            # [alert_capacity] D2H fetch this would add per firing step.
+            main["key"] = key_out
         emissions = {
-            "main": {
-                "mask": emit_valid,
-                "cols": tuple(emit_cols[:-2]),
-                "subtask": key_out % n_shards,
-                "window_end": emit_cols[-1],
-            },
+            "main": main,
             "late": {"mask": late, "cols": tuple(mid_cols)},
         }
         return new_state, emissions
