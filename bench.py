@@ -59,15 +59,17 @@ class _GenBytesSource:
     Records wall-clock marks so the caller can time the steady segment."""
 
     def __init__(self, template, time_cols, n_buffers, warm_buffers,
-                 lines_per_buffer, start_proc_ms):
+                 lines_per_buffer, start_proc_ms, rate=None):
         self.template = template          # [BL, LINE_W] uint8
         self.time_cols = time_cols        # (hh, mm, ss) column indices
         self.n_buffers = n_buffers
         self.warm = warm_buffers
         self.bl = lines_per_buffer
         self.start_proc_ms = start_proc_ms
+        self.rate = rate                  # records/s pacing (None = flood)
         self.t_steady_start = None
         self.t_end = None
+        self.max_behind_s = 0.0           # worst schedule slip when paced
 
     def batches(self, batch_size, max_delay_ms):
         import numpy as np
@@ -76,11 +78,32 @@ class _GenBytesSource:
 
         hh_c, mm_c, ss_c = self.time_cols
         arr = self.template
+        t_sched0 = None
         for b in range(self.n_buffers):
             ss, mm, hh = b % 60, (b // 60) % 60, 10 + b // 3600
             for col, v in ((hh_c, hh), (mm_c, mm), (ss_c, ss)):
                 arr[:, col] = ord("0") + v // 10
                 arr[:, col + 1] = ord("0") + v % 10
+            if self.rate:
+                # RELATIVE rate control: each buffer is released one
+                # inter-buffer interval after the previous release, and
+                # the schedule re-anchors when the pipeline falls behind
+                # (no debt accumulation — a one-off stall like the first
+                # jit compile must not turn the rest of the run into a
+                # flood). The source is pull-driven, so a slow pipeline
+                # shows up as schedule slip (max_behind_s) and a lower
+                # achieved steady rate — explicit backpressure, not an
+                # unbounded queue.
+                now = time.perf_counter()
+                if t_sched0 is not None:
+                    if now < t_sched0:
+                        time.sleep(t_sched0 - now)
+                        now = t_sched0
+                    else:
+                        self.max_behind_s = max(
+                            self.max_behind_s, now - t_sched0
+                        )
+                t_sched0 = now + self.bl / self.rate
             if b == self.warm:
                 self.t_steady_start = time.perf_counter()
             yield SourceBatch(
@@ -129,19 +152,21 @@ def _render_ch1_lines(bl):
     return arr, None
 
 
-def full_path_flagship():
+def full_path_flagship(rate=None, nbuf=200, warm=80):
     """Config 4/5 through execute_job: raw bytes -> native ISO parse +
     intern -> H2D -> sliding event-time windows -> Mbps alert sink.
     Windows scaled to (5 s, 1 s) so the 1-min watermark delay is
-    crossable in-bench; per-event device work is identical (pane ring)."""
+    crossable in-bench; per-event device work is identical (pane ring).
+    ``rate`` paces the source (records/s); None floods."""
     from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
     from tpustream.config import StreamConfig
     from tpustream.jobs.chapter3_bandwidth_eventtime import build
 
     BL, NKEY = 1 << 16, 1 << 20
-    WARM, NBUF = 80, 200
     tpl, tcols = _render_flagship_lines(BL, NKEY)
-    src = _GenBytesSource(tpl, tcols, NBUF, WARM, BL, 1_566_957_600_000)
+    src = _GenBytesSource(
+        tpl, tcols, nbuf, warm, BL, 1_566_957_600_000, rate=rate
+    )
     cfg = StreamConfig(
         batch_size=BL,
         key_capacity=NKEY,
@@ -159,10 +184,14 @@ def full_path_flagship():
     m = env.metrics
     lat = np.array(m.emit_latencies_s) * 1e3
     p99 = float(np.percentile(lat, 99)) if lat.size else None
-    return src.steady_rate(), p99, len(alerts), m.summary()
+    p50 = float(np.percentile(lat, 50)) if lat.size else None
+    return dict(
+        rate=src.steady_rate(), p99_ms=p99, p50_ms=p50, alerts=len(alerts),
+        behind_s=src.max_behind_s, summary=m.summary(),
+    )
 
 
-def full_path_ch1():
+def full_path_ch1(rate=None, nbuf=65, warm=5):
     """Config 1 through execute_job: the stateless threshold-alert job
     (parse -> filter usage>90 -> sink)."""
     from tpustream import StreamExecutionEnvironment
@@ -170,9 +199,10 @@ def full_path_ch1():
     from tpustream.jobs.chapter1_threshold import build
 
     BL = 1 << 16
-    WARM, NBUF = 5, 65
     tpl, _ = _render_ch1_lines(BL)
-    src = _GenBytesSource(tpl, (1, 4, 7), NBUF, WARM, BL, 1_563_450_000_000)
+    src = _GenBytesSource(
+        tpl, (1, 4, 7), nbuf, warm, BL, 1_563_450_000_000, rate=rate
+    )
     # time patch writes into the numeric ts field (unused by the job)
     cfg = StreamConfig(
         batch_size=BL, async_depth=4, max_batch_delay_ms=0.0
@@ -181,7 +211,98 @@ def full_path_ch1():
     alerts = []
     build(env, env.add_source(src)).add_sink(lambda r: alerts.append(r))
     env.execute("Window WordCount")
-    return src.steady_rate(), len(alerts), env.metrics.summary()
+    m = env.metrics
+    lat = np.array(m.emit_latencies_s) * 1e3
+    p99 = float(np.percentile(lat, 99)) if lat.size else None
+    p50 = float(np.percentile(lat, 50)) if lat.size else None
+    return dict(
+        rate=src.steady_rate(), p99_ms=p99, p50_ms=p50, alerts=len(alerts),
+        behind_s=src.max_behind_s, summary=m.summary(),
+    )
+
+
+def sustainable_rate(run_paced, r0, budget_ms, label):
+    """Max SUSTAINABLE rate at bounded steady-state p99 (VERDICT r2 next
+    #3): walk a descending rate ladder from the flood throughput ``r0``;
+    a rate is sustainable when the paced source never slips its schedule
+    materially (achieved >= 93% of target — explicit backpressure
+    instead of an unbounded queue) and alert p99 stays within
+    ``budget_ms``. Returns the best rung's result dict (or the last
+    tried, marked unsustainable)."""
+    best = None
+    for frac in (0.8, 0.55, 0.35, 0.2, 0.1, 0.05):
+        target = r0 * frac
+        res = run_paced(target)
+        res["target_rate"] = target
+        ok = (
+            res["rate"] >= 0.93 * target
+            and res["p99_ms"] is not None
+            and res["p99_ms"] <= budget_ms
+        )
+        res["sustainable"] = ok
+        log(
+            f"  {label} @ {target/1e6:.2f}M target -> achieved "
+            f"{res['rate']/1e6:.2f}M, p50 {res['p50_ms'] and round(res['p50_ms'])} ms, "
+            f"p99 {res['p99_ms'] and round(res['p99_ms'])} ms, "
+            f"behind {res['behind_s']:.2f}s -> "
+            f"{'SUSTAINABLE' if ok else 'over budget'}"
+        )
+        best = res
+        if ok:
+            return res
+    return best
+
+
+def host_chain_rate():
+    """The FULL host stage short of H2D, measured as one pipelined rate
+    (VERDICT r2 next #4): raw bytes -> native ISO parse + key intern ->
+    columnar Batch -> int32-delta pack. This is the chain the
+    'parse-bound ~10M lines/s/core on PCIe hosts' claim rests on; each
+    stage was previously measured alone, never as one chain."""
+    from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+    from tpustream.config import StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.runtime.executor import HostStage, Runner
+    from tpustream.runtime.metrics import Metrics
+    from tpustream.runtime.plan import build_plan_chain
+
+    BL, NKEY = 1 << 16, 1 << 20
+    tpl, tcols = _render_flagship_lines(BL, NKEY)
+    cfg = StreamConfig(
+        batch_size=BL, key_capacity=NKEY, alert_capacity=1 << 16,
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    sink = []
+    build(
+        env, env.add_source(None), size=Time.seconds(5), slide=Time.seconds(1)
+    ).add_sink(lambda r: sink.append(r))
+    plan = build_plan_chain(env, env._sinks)[0]
+    host = HostStage(plan, cfg)
+    # the Runner only supplies _pack here; shrink its key state so the
+    # device-side allocation is negligible (interning still covers the
+    # full 1M-key space through the shared plan tables)
+    import dataclasses
+
+    runner = Runner(
+        plan, dataclasses.replace(cfg, key_capacity=1024), Metrics()
+    )
+
+    src = _GenBytesSource(tpl, tcols, 40, 5, BL, 1_566_957_600_000)
+    n_lines = 0
+    for sb in src.batches(BL, 0.0):
+        if sb.final:
+            break
+        batch, _ = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
+        assert batch is not None, "native raw lane unavailable"
+        runner._pack(
+            [np.asarray(c.data) for c in batch.columns],
+            np.asarray(batch.valid),
+            np.asarray(batch.ts),
+        )
+        n_lines += sb.n_raw
+    rate = src.steady_rate()
+    return rate, n_lines
 
 
 def device_ch3_tumbling(stream_hash):
@@ -515,32 +636,62 @@ def main():
         log(f"phase E skipped: {e}")
 
     # ---- Phase F: ch1 threshold FULL PATH (config 1) --------------------
+    # F1 floods (throughput ceiling); F2 finds the max SUSTAINABLE rate
+    # at bounded steady-state p99 (backpressured pacing, not a queue)
     ch1_rate = None
+    ch1_sus = None
     try:
-        ch1_rate, ch1_alerts, ch1_sum = full_path_ch1()
+        f1 = full_path_ch1()
+        ch1_rate = f1["rate"]
         log(
-            f"phase F: ch1 threshold full path (execute_job, raw bytes): "
-            f"{ch1_rate/1e6:.2f}M events/s, {ch1_alerts} alerts"
+            f"phase F1: ch1 full path FLOOD (execute_job, raw bytes): "
+            f"{ch1_rate/1e6:.2f}M events/s, {f1['alerts']} alerts"
         )
-        log(f"phase F summary: {ch1_sum}")
+        log(f"phase F1 summary: {f1['summary']}")
+        # in-env p99 budget: the tunnel link stalls for 1-2 s at a time
+        # (measured slips up to 5 s at 3 MB/s H2D), so 2 s bounds
+        # steady-state p99 HERE; the <100 ms deployment claim rides on
+        # the device-side p99 of phase A plus a PCIe-class link
+        ch1_sus = sustainable_rate(
+            lambda r: full_path_ch1(rate=r, nbuf=40, warm=8),
+            ch1_rate, budget_ms=2000.0, label="phase F2 ch1",
+        )
     except Exception as e:  # pragma: no cover
         log(f"phase F skipped: {e}")
 
     # ---- Phase G: flagship FULL PATH (configs 4/5 end to end) -----------
     full_rate = None
     full_p99 = None
+    flag_sus = None
     try:
-        full_rate, full_p99, full_alerts, full_sum = full_path_flagship()
+        g1 = full_path_flagship()
+        full_rate, full_p99 = g1["rate"], g1["p99_ms"]
         p99_txt = f"{full_p99:.0f} ms" if full_p99 is not None else "n/a"
         log(
-            f"phase G: flagship full path (execute_job, raw bytes, "
+            f"phase G1: flagship full path FLOOD (execute_job, raw bytes, "
             f"event time): {full_rate/1e6:.2f}M events/s, "
-            f"p99 ingest->alert {p99_txt} (tunnel-inclusive), "
-            f"{full_alerts} alerts"
+            f"p99 ingest->alert {p99_txt} (queueing artifact under flood — "
+            f"see G2 for the steady-state figure), {g1['alerts']} alerts"
         )
-        log(f"phase G summary: {full_sum}")
+        log(f"phase G1 summary: {g1['summary']}")
+        flag_sus = sustainable_rate(
+            lambda r: full_path_flagship(rate=r, nbuf=110, warm=50),
+            full_rate, budget_ms=2000.0, label="phase G2 flagship",
+        )
     except Exception as e:  # pragma: no cover
         log(f"phase G skipped: {e}")
+
+    # ---- Phase I: host chain rate (parse->Batch->pack, no H2D) ----------
+    chain_rate = None
+    try:
+        chain_rate, chain_lines = host_chain_rate()
+        log(
+            f"phase I: host chain (raw bytes -> native parse+intern -> "
+            f"Batch -> delta-pack, no H2D): {chain_rate/1e6:.2f}M lines/s"
+            f"/core over {chain_lines/1e6:.1f}M lines"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase I skipped: {e}")
 
     # ---- Phase H: measured H2D bandwidth (environment context) ----------
     h2d_mb_s = None
@@ -593,11 +744,31 @@ def main():
                     "config3_ch3_tumbling_events_per_s": round(tumbling_rate or 0),
                     # configs 4+5 are the headline `value` (device pipeline)
                     "flagship_full_path_events_per_s": round(full_rate or 0),
-                    "flagship_full_path_p99_ms_tunnel": round(full_p99 or 0, 1),
+                    # steady-state sustainable figures (rate-controlled,
+                    # backpressured — the honest full-path numbers; the
+                    # flood p99 is a queueing artifact and is not
+                    # reported)
+                    "ch1_sustainable_rate_events_per_s": round(
+                        (ch1_sus or {}).get("target_rate") or 0
+                    ),
+                    "ch1_sustainable_p99_ms": round(
+                        (ch1_sus or {}).get("p99_ms") or 0, 1
+                    ),
+                    "ch1_sustainable": bool((ch1_sus or {}).get("sustainable")),
+                    "flagship_sustainable_rate_events_per_s": round(
+                        (flag_sus or {}).get("target_rate") or 0
+                    ),
+                    "flagship_sustainable_p99_ms": round(
+                        (flag_sus or {}).get("p99_ms") or 0, 1
+                    ),
+                    "flagship_sustainable": bool(
+                        (flag_sus or {}).get("sustainable")
+                    ),
                     # environment context for the full-path numbers: the
                     # chip sits behind a tunnel; H2D is the binding stage
                     "h2d_bandwidth_mb_per_s": round(h2d_mb_s or 0),
                     "native_parse_lines_per_s": round(parse_rate or 0),
+                    "host_chain_lines_per_s": round(chain_rate or 0),
                 },
             }
         ),

@@ -238,3 +238,38 @@ def test_chain_after_process_stage():
         ("a", 8.0),
         ("b", 16.0),
     ]
+
+
+def test_chain_after_process_mixed_int_float_rows_widen():
+    """The lazy schema must WIDEN across collected rows: a fn emitting
+    an int on one fire and a float on another must not silently truncate
+    the float (regression: first-row-only inference inferred I64)."""
+    from tpustream import Tuple2
+
+    def alternating(key, ctx, elements, out):
+        n = len(list(elements))
+        # odd-sized windows emit an int, even-sized a fractional float
+        out.collect(Tuple2(key, n if n % 2 else n + 0.5))
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(LINES))
+    handle = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .process(alternating)
+        .key_by(0)
+        .window(__import__("tpustream.api.windows", fromlist=["w"])
+                .TumblingProcessingTimeWindows.of(Time.minutes(5)))
+        .reduce(lambda p, q: Tuple2(p.f0, p.f1 + q.f1))
+        .collect()
+    )
+    env.execute("widen")
+    got = dict((t.f0, t.f1) for t in handle.items)
+    # counts per stage-1 window: a:[0,10s)=2 -> 2.5, a:[10,20s)=1 -> 1,
+    # b:[0,10s)=1 -> 1, b:[20,30s)=1 -> 1
+    assert got == {"a": 3.5, "b": 2.0}

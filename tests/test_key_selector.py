@@ -1,0 +1,70 @@
+"""keyBy(KeySelector): Flink's surface accepts a key function, not just
+a field index (VERDICT r2 missing #5). Field-projecting selectors — the
+practical usage — resolve to field indices at plan time via a sentinel
+probe (runtime/plan.py resolve_key_selector); derived-key selectors are
+rejected with a remediation message.
+"""
+
+import pytest
+
+from tpustream import KeySelector, StreamExecutionEnvironment, Tuple2
+from tpustream.config import StreamConfig
+from tpustream.runtime.plan import resolve_key_selector
+from tpustream.runtime.sources import ReplaySource
+
+
+def parse(line):
+    p = line.split(" ")
+    return Tuple2(p[0], float(p[1]))
+
+
+LINES = ["a 1", "b 10", "a 2", "b 20", "a 4"]
+
+
+def run(key):
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=2, key_capacity=16))
+    text = env.add_source(ReplaySource(LINES))
+    h = (
+        text.map(parse)
+        .key_by(key)
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    env.execute("selector")
+    return [(t.f0, t.f1) for t in h.items]
+
+
+def test_lambda_selector_matches_field_index():
+    assert run(lambda r: r.f0) == run(0)
+
+
+def test_key_selector_class():
+    class ByHost(KeySelector):
+        def get_key(self, value):
+            return value.f0
+
+    assert run(ByHost()) == run(0)
+
+
+def test_key_selector_camel_case_override():
+    # Flink-style subclass overriding ONLY getKey (the advertised alias)
+    class ByHost(KeySelector):
+        def getKey(self, value):
+            return value.f0
+
+    assert run(ByHost()) == run(0)
+
+
+def test_getitem_selector():
+    assert run(lambda r: r[0]) == run(0)
+
+
+def test_resolver_units():
+    assert resolve_key_selector(1) == 1
+    assert resolve_key_selector(lambda r: r.f2) == 2
+    assert resolve_key_selector(lambda r: r[3]) == 3
+
+
+def test_derived_key_selector_rejected_clearly():
+    with pytest.raises(NotImplementedError, match="derived"):
+        resolve_key_selector(lambda r: str(r.f0) + "x")

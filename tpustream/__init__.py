@@ -38,6 +38,7 @@ from .api.watermarks import (  # noqa: E402
 from .api.functions import (  # noqa: E402
     AggregateFunction,
     FilterFunction,
+    KeySelector,
     MapFunction,
     ProcessWindowFunction,
     ReduceFunction,
@@ -52,6 +53,7 @@ __all__ = [
     "AssignerWithPeriodicWatermarks",
     "BoundedOutOfOrdernessTimestampExtractor",
     "FilterFunction",
+    "KeySelector",
     "MapFunction",
     "OutputTag",
     "ProcessWindowFunction",

@@ -34,6 +34,18 @@ class ReduceFunction(Generic[IN]):
         raise NotImplementedError
 
 
+class KeySelector(Generic[IN, KEY]):
+    """Flink's KeySelector surface: ``keyBy`` accepts one of these (or a
+    plain callable) instead of a field index. The TPU planner resolves a
+    field-projecting selector to its field index at plan time
+    (runtime/plan.py resolve_key_selector)."""
+
+    def get_key(self, value: IN) -> KEY:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    getKey = get_key
+
+
 class AggregateFunction(Generic[IN, ACC, OUT]):
     """Incremental aggregation contract (create/add/get_result/merge).
 

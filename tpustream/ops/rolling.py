@@ -119,6 +119,7 @@ def rolling_step(
     key_col: int = None,
     key_emit: Callable = None,
     sentinel_leaf: int = None,
+    sort_also: Tuple[jnp.ndarray, ...] = (),
 ) -> Tuple[dict, Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batch through a rolling aggregate.
 
@@ -139,11 +140,16 @@ def rolling_step(
     once the key space is warm — on v5e this roughly halves step cost at
     1M keys (the general path pays one ~2.6 ms 32-bit plane scatter per
     record field per batch).
+
+    ``sort_also``: extra [B] arrays to return permuted into the same
+    sorted order (appended as a trailing tuple iff non-empty) — cheaper
+    than the caller re-deriving the permutation from ``inv``.
     """
     if rolling_kind in ("max", "min", "sum"):
         return _rolling_step_commutative(
             state, keys, cols, valid, kinds, compact32,
             rolling_kind, rolling_pos, key_col, key_emit, sentinel_leaf,
+            sort_also,
         )
     K = state["seen"].shape[0]
     perm, sk, sv, seg_starts = sort_by_key(keys, valid, max_key=K)
@@ -174,7 +180,10 @@ def rolling_step(
     new_seen = state["seen"].at[idx].set(True, mode="drop", unique_indices=True)
 
     inv = inverse_permutation(perm)
-    return {"seen": new_seen, "planes": new_planes}, emis_sorted, sv, sk, inv
+    out = ({"seen": new_seen, "planes": new_planes}, emis_sorted, sv, sk, inv)
+    if sort_also:
+        out = out + (tuple(x[perm] for x in sort_also),)
+    return out
 
 
 _REDUCERS = {
@@ -186,7 +195,7 @@ _REDUCERS = {
 
 def _rolling_step_commutative(
     state, keys, cols, valid, kinds, compact32, kind, pos, key_col, key_emit,
-    sentinel_leaf=None,
+    sentinel_leaf=None, sort_also=(),
 ):
     """Fast path for max/min/sum field aggregates (see rolling_step)."""
     K = state["seen"].shape[0]
@@ -308,12 +317,15 @@ def _rolling_step_commutative(
             kj += 1
 
     inv = inverse_permutation(perm)
-    return (
+    out = (
         {"seen": new_seen, "planes": new_planes},
         tuple(emis_sorted),
         sv,
         sk,
         inv,
     )
+    if sort_also:
+        out = out + (tuple(x[perm] for x in sort_also),)
+    return out
 
 

@@ -194,6 +194,41 @@ class HostStage:
         )
 
 
+def _row_fields(row) -> list:
+    """Positional fields of a user-collected row (Tuple / tuple / scalar)."""
+    from ..api.tuples import TupleBase
+
+    return list(row) if isinstance(row, (TupleBase, tuple)) else [row]
+
+
+def _infer_row_kinds(rows) -> List[str]:
+    """Column kinds for user-collected rows, WIDENED across every row
+    (any str -> STR; else any non-bool float/int mix -> F64; all bool ->
+    BOOL; else I64)."""
+    from ..records import BOOL, F64, I64
+
+    fields = [_row_fields(r) for r in rows]
+    arity = len(fields[0])
+    for f in fields:
+        if len(f) != arity:
+            raise ValueError(
+                f"chained process() stage collected rows of mixed arity "
+                f"({arity} vs {len(f)}); emit one consistent shape"
+            )
+    kinds = []
+    for i in range(arity):
+        vs = [f[i] for f in fields]
+        if any(isinstance(v, str) for v in vs):
+            kinds.append(STR)
+        elif all(isinstance(v, bool) for v in vs):
+            kinds.append(BOOL)
+        elif any(isinstance(v, float) for v in vs):
+            kinds.append(F64)
+        else:
+            kinds.append(I64)
+    return kinds
+
+
 def _bind_ops(ops):
     """Pre-resolve (op, fn) pairs to callables for per-record replay."""
     return [(op, as_callable(fn, op)) for op, fn in ops]
@@ -590,14 +625,17 @@ class Runner:
 
     def _build_lazy_downstream(self) -> "Runner":
         """Process()-fed chains resolve the downstream record schema from
-        the first collected rows (the user function may emit any shape),
-        then build the remaining runner chain."""
-        # one row suffices for schema inference; the full conversion
-        # happens once, in _rows_to_cols
-        rows = [self._chain_rows[0][0]]
-        _, kinds = run_fallback_map(lambda r: r, rows, self._lazy_plans[0].tables)
+        the buffered collected rows (the user function may emit any
+        shape), then build the remaining runner chain. Kinds WIDEN
+        across all buffered rows — a median fn emits ints on odd counts
+        and floats on even ones, and first-row inference would silently
+        truncate the floats."""
+        from ..records import StringTable
+
+        kinds = _infer_row_kinds([item for item, _ in self._chain_rows])
         p2 = self._lazy_plans[0]
         p2.record_kinds.extend(kinds)
+        p2.tables.extend(StringTable() if k == STR else None for k in kinds)
         d = _make_runner_chain(self._lazy_plans, self.cfg, self.metrics)
         self._lazy_plans = []
         self.chain_to(d)
@@ -606,7 +644,8 @@ class Runner:
 
     def _rows_to_cols(self):
         """Convert buffered process() rows to the downstream's columnar
-        schema (established at lazy build)."""
+        schema (established at lazy build; values coerce to the widened
+        plan kinds)."""
         rows = [item for item, _ in self._chain_rows]
         ts = (
             np.asarray([t for _, t in self._chain_rows], dtype=np.int64)
@@ -614,9 +653,21 @@ class Runner:
             else None
         )
         d = self.downstream
-        cols, _ = run_fallback_map(lambda r: r, rows, d.plan.tables)
+        kinds, tables = d.plan.record_kinds, d.plan.tables
+        fields = [_row_fields(r) for r in rows]
+        cols = []
+        for i, (k, table) in enumerate(zip(kinds, tables)):
+            vs = [f[i] for f in fields]
+            if k == STR:
+                cols.append(table.intern_many([str(v) for v in vs]))
+            else:
+                cols.append(
+                    np.asarray(vs, dtype={
+                        "f64": np.float64, "i64": np.int64, "bool": np.bool_,
+                    }[k])
+                )
         self._chain_rows = []
-        return cols, ts, d.plan.record_kinds, d.plan.tables
+        return cols, ts, kinds, tables
 
     def pump_chain(self, proc_now: int):
         """Move buffered emissions to the downstream runner (or tick its
@@ -889,6 +940,17 @@ class Runner:
                     sink.emit(item)
 
 
+def _reject_count_ts(st):
+    """Count-window results carry no event timestamps (Flink's
+    GlobalWindow has none), so they cannot feed event-time stages."""
+    if st is not None and st.window is not None and st.window.kind == "count":
+        raise NotImplementedError(
+            "count-window results carry no event timestamps (Flink's "
+            "GlobalWindow); window the chained stage in processing time, "
+            "or use a time window upstream"
+        )
+
+
 def _chain_needs_event_ts(plans) -> bool:
     """True when any stage in ``plans`` windows in event time (its input
     records then need timestamps from the upstream stage)."""
@@ -917,21 +979,10 @@ def _wire_chain_ts(up: Runner, down: Runner):
     if not _chain_needs_event_ts(rest_plans):
         return
     up._chain_ts = True
-    prog = up.program
     st = up.plan.stateful
-    if st is not None and st.window is not None and st.window.kind == "count":
-        if st.apply_kind != "process":
-            raise NotImplementedError(
-                "count-window results carry no event timestamps (Flink's "
-                "GlobalWindow); window the chained stage in processing "
-                "time, or use a time window upstream"
-            )
-        raise NotImplementedError(
-            "count_window process() results carry no event timestamps; "
-            "window the chained stage in processing time"
-        )
+    _reject_count_ts(st)
     if st is not None and st.kind in ("rolling", "rolling_reduce"):
-        prog.emit_ts = True  # read at trace time (first batch)
+        up.program.emit_ts = True  # read at trace time (first batch)
 
 
 def _make_runner_chain(plans, cfg, metrics) -> Runner:
@@ -947,14 +998,8 @@ def _make_runner_chain(plans, cfg, metrics) -> Runner:
         if getattr(up.program, "host_evaluated", False):
             up._lazy_plans = list(plans[i:])
             up._chain_ts = _chain_needs_event_ts(up._lazy_plans)
-            if up._chain_ts and up.plan.stateful.window is not None and (
-                up.plan.stateful.window.kind == "count"
-            ):
-                raise NotImplementedError(
-                    "count-window results carry no event timestamps "
-                    "(Flink's GlobalWindow); window the chained stage in "
-                    "processing time"
-                )
+            if up._chain_ts:
+                _reject_count_ts(up.plan.stateful)
             break
         p2.record_kinds.extend(up.program.out_kinds)
         p2.tables.extend(up.program.out_tables)
@@ -1030,6 +1075,14 @@ def execute_job(env, sink_nodes) -> JobResult:
     lines_consumed = skip_lines
     ckpt_every = cfg.checkpoint_interval_batches
     ckpt_enabled = bool(cfg.checkpoint_dir) and ckpt_every > 0
+    # Emission pipelining helps only when batches arrive back to back; a
+    # PACED source (steady-rate feed with idle gaps) would otherwise see
+    # its results parked in the in-flight window for async_depth batch
+    # intervals — latency inflating as the rate drops. When the gap
+    # since the previous batch exceeds one pipelining quantum, fetch
+    # synchronously: the link is idle anyway.
+    t_last_feed: Optional[float] = None
+    IDLE_GAP_S = 0.05
 
     def wm_lower_for_records(wm_hint: Optional[int]) -> int:
         if domain == TimeCharacteristic.ProcessingTime:
@@ -1086,7 +1139,13 @@ def execute_job(env, sink_nodes) -> JobResult:
         if batch is not None:
             if runner is None:
                 runner = _make_runner_chain(plans, cfg, metrics)
+            idle = (
+                t_last_feed is not None and hw.t0 - t_last_feed > IDLE_GAP_S
+            )
+            t_last_feed = hw.t0
             runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
+            if idle:
+                runner.drain_inflight()
         elif (
             sb.advance_proc_to is not None
             and runner is not None

@@ -34,6 +34,70 @@ class HostOp:
     plan: Optional[hostparse.HostMapPlan] = None  # symbolic plan for maps
 
 
+class _FieldProbe:
+    """Sentinel standing in for one record field during key-selector
+    probing."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+class _RecordProbe:
+    """Record stand-in handed to a KeySelector at plan time: any
+    ``fN`` / ``[N]`` access returns a field sentinel, so a selector that
+    PROJECTS a field resolves to its index without running on data."""
+
+    def __getattr__(self, name: str):
+        if name.startswith("f") and name[1:].isdigit():
+            return _FieldProbe(int(name[1:]))
+        raise AttributeError(name)
+
+    def __getitem__(self, i: int):
+        return _FieldProbe(int(i))
+
+
+def resolve_key_selector(key: Any) -> int:
+    """Turn a ``keyBy`` argument into a tuple field index.
+
+    Flink's surface accepts a field index or a ``KeySelector``; every
+    reference job uses indices (chapter2/.../ComputeCpuMax.java:26), and
+    in practice selectors project a field (``r -> r.f1``). The TPU
+    runtime keys on dense interned column ids, so a selector is resolved
+    AT PLAN TIME by probing it with a sentinel record: if it returns one
+    field unchanged, that field's index is the key. Selectors that
+    COMPUTE a derived key would need a device-traced key column and are
+    rejected with a clear error.
+    """
+    if isinstance(key, int):
+        return key
+    # probe every plausible entry point: a KeySelector subclass may
+    # override either get_key or the Flink-style getKey alias (the
+    # un-overridden one still resolves to the abstract base method and
+    # raises — skip it, don't give up)
+    candidates = [
+        getattr(key, meth)
+        for meth in ("get_key", "getKey")
+        if hasattr(key, meth)
+    ]
+    if callable(key):
+        candidates.append(key)
+    for fn in candidates:
+        try:
+            out = fn(_RecordProbe())
+        except Exception:
+            continue
+        if isinstance(out, _FieldProbe):
+            return out.index
+    raise NotImplementedError(
+        "key_by takes a tuple field index or a KeySelector that projects "
+        "one record field (e.g. lambda r: r.f1); selectors computing "
+        "derived keys are not supported — add the derived field with a "
+        "map() and key on it"
+    )
+
+
 @dataclass
 class StatefulSpec:
     kind: str                   # rolling | rolling_reduce | window
@@ -222,13 +286,7 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
                 # next stage, fed by this stage's emissions
                 chain_rest = nodes[nodes.index(node):]
                 break
-            key = node.params["key"]
-            if not isinstance(key, int):
-                raise NotImplementedError(
-                    "key_by currently takes a tuple field index (as the "
-                    "reference jobs do: keyBy(0)/keyBy(1))"
-                )
-            key_pos = key
+            key_pos = resolve_key_selector(node.params["key"])
             continue
         if op == "rolling":
             if key_pos is None:
@@ -359,12 +417,7 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
             if stateful is not None:
                 chain_rest = rest[i:]
                 break
-            key = node.params["key"]
-            if not isinstance(key, int):
-                raise NotImplementedError(
-                    "key_by currently takes a tuple field index"
-                )
-            key_pos = key
+            key_pos = resolve_key_selector(node.params["key"])
             continue
         if op == "rolling":
             if key_pos is None:

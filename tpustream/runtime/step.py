@@ -239,10 +239,17 @@ class RollingProgram(BaseProgram):
                 fast_kwargs["key_emit"] = (
                     lambda sks: self._global_key_ids(sks).astype(dt)
                 )
-        new_state, emitted_sorted, sv, sk, inv = rolling_ops.rolling_step(
+        emit_ts = getattr(self, "emit_ts", False)
+        if emit_ts:
+            # chained stages with event-time windows downstream: the
+            # rolling aggregate forwards the input record's timestamp,
+            # permuted by the step's own sort (no extra inversion)
+            fast_kwargs["sort_also"] = (ts,)
+        out = rolling_ops.rolling_step(
             state, keys, tuple(mid_cols), mask, self.combine,
             self.mid_kinds, self._compact32, **fast_kwargs,
         )
+        new_state, emitted_sorted, sv, sk, inv = out[:5]
         # emissions stay in sorted order; the host un-permutes via
         # emissions["order"] (device-side inverse gathers dominate the
         # rolling step cost on v5e)
@@ -259,13 +266,8 @@ class RollingProgram(BaseProgram):
             "subtask": subtask,
             "order": self._row_offset(inv.shape[0]) + inv.astype(jnp.int32),
         }
-        if getattr(self, "emit_ts", False):
-            # chained stages with event-time windows downstream: a
-            # rolling aggregate forwards the input record's timestamp
-            # (Flink's per-record emission keeps the element timestamp)
-            from ..ops.segments import inverse_permutation
-
-            main["ts"] = ts[inverse_permutation(inv)]
+        if emit_ts:
+            main["ts"] = out[5][0]
         return new_state, {"main": main}
 
 
