@@ -273,3 +273,43 @@ def test_chain_after_process_mixed_int_float_rows_widen():
     # counts per stage-1 window: a:[0,10s)=2 -> 2.5, a:[10,20s)=1 -> 1,
     # b:[0,10s)=1 -> 1, b:[20,30s)=1 -> 1
     assert got == {"a": 3.5, "b": 2.0}
+
+
+def test_chain_after_process_late_float_fails_loudly():
+    """A fractional emission AFTER the schema froze as int (it arrived
+    in a later pump than the inference rows) must raise, not silently
+    truncate."""
+    from tpustream import Tuple2
+
+    def alternating(key, ctx, elements, out):
+        n = len(list(elements))
+        out.collect(Tuple2(key, n if n % 2 else n + 0.5))
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=1, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    # first fired window: a single 'a' record in [0,10s) -> int 1 (the
+    # schema freezes I64); a LATER pump fires a 2-element window -> 2.5
+    lines = [
+        "1000 a x 5",
+        "12000 a x 3",     # fires [0,10s): count 1 -> int
+        "13000 a x 7",
+        "26000 a x 9",     # fires [10,20s): count 2 -> 2.5 (fractional)
+        "40000 a x 1",
+    ]
+    text = env.add_source(ReplaySource(lines))
+    (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .process(alternating)
+        .key_by(0)
+        .window(__import__("tpustream.api.windows", fromlist=["w"])
+                .TumblingProcessingTimeWindows.of(Time.minutes(5)))
+        .reduce(lambda p, q: Tuple2(p.f0, p.f1 + q.f1))
+        .collect()
+    )
+    with pytest.raises(ValueError, match="fractional"):
+        env.execute("late-float")

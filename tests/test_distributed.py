@@ -223,6 +223,209 @@ JOB_WORKER = (
 )
 
 
+PROCESS_JOB_SNIPPET = textwrap.dedent(
+    """
+    def run_job(lines):
+        from tpustream import (
+            BoundedOutOfOrdernessTimestampExtractor,
+            StreamExecutionEnvironment,
+            Time,
+            TimeCharacteristic,
+            Tuple2,
+            Tuple3,
+        )
+        from tpustream.config import StreamConfig
+        from tpustream.runtime.sources import ReplaySource
+
+        class Ts(BoundedOutOfOrdernessTimestampExtractor):
+            def __init__(self):
+                super().__init__(Time.milliseconds(2000))
+
+            def extract_timestamp(self, value):
+                return int(value.split(" ")[0])
+
+        def parse(line):
+            p = line.split(" ")
+            return Tuple3(int(p[0]), p[1], int(p[2]))
+
+        def median(key, ctx, elements, out):
+            vals = sorted(e.f2 for e in elements)
+            mid = len(vals) // 2
+            med = (
+                float(vals[mid]) if len(vals) % 2
+                else (vals[mid - 1] + vals[mid]) / 2
+            )
+            out.collect(Tuple2(key, med))
+
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=16, key_capacity=64, parallelism=8)
+        )
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        text = env.add_source(ReplaySource(lines))
+        handle = (
+            text.assign_timestamps_and_watermarks(Ts())
+            .map(parse)
+            .key_by(1)
+            .time_window(Time.seconds(5))
+            .process(median)
+            .collect()
+        )
+        env.execute("TwoHostProcessJob")
+        return [repr(t) for t in handle.items]
+    """
+)
+
+
+def _run_two_process_job(tmp_path, snippet):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = (
+        textwrap.dedent(
+            """
+            import os, sys
+            os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ.pop("JAX_COORDINATOR_ADDRESS", None)
+            pid, port = int(sys.argv[1]), sys.argv[2]
+            from tpustream.parallel import distributed
+
+            distributed.initialize(
+                coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+            )
+            import jax
+            assert jax.process_count() == 2
+            lines = sys.stdin.read().splitlines()
+            """
+        )
+        + snippet
+        + textwrap.dedent(
+            """
+            for r in run_job(lines):
+                print("ROW\\t" + r)
+            print(f"worker {pid}: ok")
+            """
+        )
+    )
+    script = tmp_path / "job_worker.py"
+    script.write_text(worker)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    # feed BOTH stdin pipes before waiting on either: the workers run
+    # one SPMD program and block on each other's collectives
+    for p in procs:
+        p.stdin.write("\n".join(JOB_LINES))
+        p.stdin.close()
+    outs = []
+    for p in procs:
+        outs.append(p.stdout.read())
+        p.wait(timeout=280)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"job worker {i} failed:\n{out}"
+        assert f"worker {i}: ok" in out
+    got = sorted(
+        line.split("\t", 1)[1]
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("ROW\t")
+    )
+    per_proc = [
+        sum(1 for line in out.splitlines() if line.startswith("ROW\t"))
+        for out in outs
+    ]
+    return got, per_proc
+
+
+def test_two_process_process_window_job(tmp_path):
+    """Full-window process() across two hosts: each process evaluates
+    its OWN shards' fires from locally fetched state; the union matches
+    a single-process run exactly."""
+    got, per_proc = _run_two_process_job(tmp_path, PROCESS_JOB_SNIPPET)
+    ns = {}
+    exec(PROCESS_JOB_SNIPPET, ns)
+    expect = sorted(ns["run_job"](JOB_LINES))
+    assert expect, "single-process reference produced no output"
+    assert got == expect
+    assert all(n < len(expect) for n in per_proc), per_proc
+
+
+SESSION_PROCESS_JOB_SNIPPET = textwrap.dedent(
+    """
+    def run_job(lines):
+        from tpustream import (
+            BoundedOutOfOrdernessTimestampExtractor,
+            StreamExecutionEnvironment,
+            Time,
+            TimeCharacteristic,
+            Tuple2,
+            Tuple3,
+        )
+        from tpustream.api.windows import EventTimeSessionWindows
+        from tpustream.config import StreamConfig
+        from tpustream.runtime.sources import ReplaySource
+
+        class Ts(BoundedOutOfOrdernessTimestampExtractor):
+            def __init__(self):
+                super().__init__(Time.milliseconds(2000))
+
+            def extract_timestamp(self, value):
+                return int(value.split(" ")[0])
+
+        def parse(line):
+            p = line.split(" ")
+            return Tuple3(int(p[0]), p[1], int(p[2]))
+
+        def spans(key, ctx, elements, out):
+            vals = [e.f2 for e in elements]
+            out.collect(Tuple2(key, float(sum(vals))))
+
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=16, key_capacity=64, parallelism=8)
+        )
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        text = env.add_source(ReplaySource(lines))
+        handle = (
+            text.assign_timestamps_and_watermarks(Ts())
+            .map(parse)
+            .key_by(1)
+            .window(EventTimeSessionWindows.with_gap(Time.seconds(3)))
+            .process(spans)
+            .collect()
+        )
+        env.execute("TwoHostSessionProcessJob")
+        return [repr(t) for t in handle.items]
+    """
+)
+
+
+def test_two_process_session_process_job(tmp_path):
+    """Session windows + process() across two hosts: exercises the
+    replicated-scalar state fetch (hi/wm are 0-d, pending_mark is
+    key-sharded) in the multi-host host-evaluation path."""
+    got, per_proc = _run_two_process_job(tmp_path, SESSION_PROCESS_JOB_SNIPPET)
+    ns = {}
+    exec(SESSION_PROCESS_JOB_SNIPPET, ns)
+    expect = sorted(ns["run_job"](JOB_LINES))
+    assert expect, "single-process reference produced no output"
+    assert got == expect
+    assert all(n < len(expect) for n in per_proc), per_proc
+
+
 def test_two_process_job_matches_single_process(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

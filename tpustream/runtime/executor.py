@@ -324,14 +324,10 @@ class Runner:
                     "multi-host execution needs a sharded program: set "
                     "StreamConfig.parallelism to the global device count"
                 )
-            if self.program.emissions_reference_state or getattr(
-                self.program, "host_evaluated", False
-            ):
-                raise NotImplementedError(
-                    "full-window process() jobs are not supported across "
-                    "hosts yet (their fires are evaluated against global "
-                    "state on the driving host); use reduce/aggregate"
-                )
+            # host-evaluated (process()) programs read state through a
+            # local-shard fetcher: each process evaluates and emits its
+            # OWN keys' fires (same ownership rule as device emissions)
+            self.program._host_fetch = self._fetch_local
             if cfg.parallelism % jax.process_count():
                 raise ValueError(
                     f"parallelism ({cfg.parallelism}) must divide evenly "
@@ -468,11 +464,22 @@ class Runner:
     def _fetch_local(self, tree):
         """device_get that returns only THIS process's shards of
         non-fully-addressable leaves (each process dispatches its own
-        shards' emissions)."""
+        shards' emissions). Replicated leaves — scalars like the
+        watermark/`hi`, and per-ring metadata — live on every device,
+        so one local copy IS the whole value."""
         def get(x):
             if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                shards = sorted(
-                    x.addressable_shards, key=lambda s: s.index
+                shards = list(x.addressable_shards)
+                replicated = x.ndim == 0 or all(
+                    (sl.start in (None, 0))
+                    and (sl.stop in (None, x.shape[d]))
+                    for s in shards
+                    for d, sl in enumerate(s.index)
+                )
+                if replicated:
+                    return np.asarray(shards[0].data)
+                shards.sort(
+                    key=lambda s: tuple(sl.start or 0 for sl in s.index)
                 )
                 return np.concatenate(
                     [np.asarray(s.data) for s in shards]
@@ -660,10 +667,24 @@ class Runner:
             vs = [f[i] for f in fields]
             if k == STR:
                 cols.append(table.intern_many([str(v) for v in vs]))
+            elif k == "i64":
+                # the schema froze at the first pump; a later float (or
+                # str) emission would otherwise truncate silently
+                arr = np.asarray(vs)
+                if arr.dtype.kind not in "iub" and not np.all(
+                    arr == np.floor(arr)
+                ):
+                    raise ValueError(
+                        f"chained process() stage emitted a fractional "
+                        f"value in field {i} after its schema was "
+                        f"inferred as int from earlier rows; emit one "
+                        f"consistent type (e.g. always float)"
+                    )
+                cols.append(arr.astype(np.int64))
             else:
                 cols.append(
                     np.asarray(vs, dtype={
-                        "f64": np.float64, "i64": np.int64, "bool": np.bool_,
+                        "f64": np.float64, "bool": np.bool_,
                     }[k])
                 )
         self._chain_rows = []

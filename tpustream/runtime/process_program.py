@@ -284,7 +284,10 @@ class ProcessWindowProgram(WindowProgram):
         Sharded layout: state/emission leaves assemble with shard-major
         key rows (row = shard * local_keys + local_row holds global key
         ``local_row * n_shards + shard``), and replicated per-candidate
-        leaves arrive stacked once per shard — slice the first copy."""
+        leaves arrive stacked once per shard — slice the first copy.
+        Multi-host: ``_host_fetch`` returns only THIS process's shards'
+        rows and ``_host_shard_base`` offsets the shard mapping, so each
+        process evaluates (and emits) its own keys' fires."""
         ring = self.ring
         F = ring.n_fire_candidates
         S = max(1, self.n_shards)
@@ -295,20 +298,23 @@ class ProcessWindowProgram(WindowProgram):
         ends = np.asarray(fire_info["ends"]).reshape(-1)[:F]
         cand = np.asarray(fire_info["cand"]).reshape(-1)[:F]
         wm = int(np.asarray(fire_info["wm"]).reshape(-1)[0])
-        cnt = np.asarray(state["cnt"])
-        slot_pane = np.asarray(state["slot_pane"])
-        bufs = [np.asarray(b) for b in state["buf"]]
+        cnt = self._host_fetch(state["cnt"])
+        slot_pane = self._host_fetch(state["slot_pane"])
+        bufs = [self._host_fetch(b) for b in state["buf"]]
         n, cap = ring.n_slots, self.cfg.process_buffer_capacity
         kinds, tables = self.mid_kinds, self.mid_tables
         key_table = tables[self.key_pos]
         k_local = self.local_key_capacity
+        shard_base = self._host_shard_base()
         emitted = 0
         fired = 0
 
         for j in np.nonzero(fire)[0]:
             live_keys = np.nonzero(win_cnt[:, j] > 0)[0]
             for key_row in live_keys:
-                key_id = int(key_row % k_local) * S + int(key_row // k_local)
+                key_id = int(key_row % k_local) * S + shard_base + int(
+                    key_row // k_local
+                )
                 elements = []
                 for q in range(int(cand[j]) - ring.panes_per_window + 1, int(cand[j]) + 1):
                     s = q % n

@@ -633,18 +633,19 @@ class SessionProcessProgram(ProcessWindowProgram):
         S = max(1, self.n_shards)
         k_local = self.local_key_capacity
         wm = int(np.asarray(fire_info["wm"]).reshape(-1)[0])
-        cnt = np.asarray(state["cnt"])
-        cmin = np.asarray(state["cell_min"])
-        cmax = np.asarray(state["cell_max"])
-        hi = int(np.asarray(state["hi"]))
-        bufs = [np.asarray(b) for b in state["buf"]]
+        cnt = self._host_fetch(state["cnt"])
+        cmin = self._host_fetch(state["cell_min"])
+        cmax = self._host_fetch(state["cell_max"])
+        hi = int(self._host_fetch(state["hi"]))
+        bufs = [self._host_fetch(b) for b in state["buf"]]
         kinds, tables = self.mid_kinds, self.mid_tables
         key_table = tables[self.key_pos]
+        shard_base = self._host_shard_base()
 
         o = np.arange(n, dtype=np.int64)
         pane_ids = hi - n + 1 + o
         slot_o = (pane_ids % n).astype(np.int64)
-        cleared = np.asarray(state["pending_mark"])[:, slot_o]
+        cleared = self._host_fetch(state["pending_mark"])[:, slot_o]
         mn = np.where(cleared, cmin[:, slot_o], TS_MAX)
         mx = np.where(cleared, cmax[:, slot_o], W0)
         link = sess_ops.session_links(cleared, mn, mx, gap, xp=np)
@@ -674,7 +675,9 @@ class SessionProcessProgram(ProcessWindowProgram):
                         elements.append(
                             vals[0] if len(vals) == 1 else make_tuple(*vals)
                         )
-                key_id = int(key_row % k_local) * S + int(key_row // k_local)
+                key_id = int(key_row % k_local) * S + shard_base + int(
+                    key_row // k_local
+                )
                 key_val = (
                     key_table.lookup(key_id)
                     if key_table is not None

@@ -89,6 +89,20 @@ class BaseProgram:
     n_shards = 1
     vary_axes: tuple = ()
 
+    # host-side fetch of state/emission leaves for host-evaluated
+    # programs: plain numpy on one host; the multi-host executor swaps
+    # in a local-shard fetcher (each process evaluates ITS keys' fires)
+    _host_fetch = staticmethod(np.asarray)
+
+    def _host_shard_base(self) -> int:
+        """First mesh-shard index covered by this process's local state
+        rows (0 on one host)."""
+        import jax as _jax
+
+        if _jax.process_count() <= 1:
+            return 0
+        return _jax.process_index() * (self.n_shards // _jax.process_count())
+
     def _row_offset(self, n_local_rows: int):
         """Offset of this shard's emission rows in the concatenated
         output (0 on one chip; shard_index * local_rows on a mesh) so
