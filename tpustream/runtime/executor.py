@@ -194,6 +194,34 @@ class HostStage:
         )
 
 
+def _allgather_rows(arrays: List[np.ndarray]) -> List[np.ndarray]:
+    """Concatenate each array's rows across ALL processes (row counts
+    may differ per process: gather the counts, pad to the max, gather,
+    trim). Host-level DCN collective — used only on the chain hand-off,
+    at alert scale, never on the per-record path."""
+    from jax.experimental import multihost_utils as mh
+
+    counts = mh.process_allgather(
+        np.asarray([arrays[0].shape[0]], np.int64)
+    ).reshape(-1)
+    mx = int(counts.max())
+    if not mx:
+        # globally empty step (the common case: most steps fire
+        # nothing): mx is SPMD-identical, so every process skips the
+        # data gathers together — collective counts stay aligned
+        return arrays
+    out = []
+    for a in arrays:
+        pad = np.zeros((mx - a.shape[0],) + a.shape[1:], a.dtype)
+        g = mh.process_allgather(np.concatenate([a, pad]))
+        out.append(
+            np.concatenate(
+                [g[p, : int(counts[p])] for p in range(g.shape[0])]
+            )
+        )
+    return out
+
+
 def _row_fields(row) -> list:
     """Positional fields of a user-collected row (Tuple / tuple / scalar)."""
     from ..api.tuples import TupleBase
@@ -351,9 +379,12 @@ class Runner:
             ]
             self.state = jax.tree_util.tree_unflatten(treedef, placed)
         # chained stages: emissions feed the downstream runner as
-        # columnar batches instead of the sinks (build_plan_chain)
+        # columnar batches instead of the sinks (build_plan_chain).
+        # Entry shape per step: single-host (cols, ts_or_None);
+        # multi-host (cols, window_end, key) — the canonical sort and
+        # ts extraction happen after the cross-process gather
         self.downstream: Optional["Runner"] = None
-        self._chain_buf: List[tuple] = []   # (cols, ts_or_None) per step
+        self._chain_buf: List[tuple] = []
         self._chain_rows: List[tuple] = []  # (item, ts) from process() fires
         self._lazy_plans: List[JobPlan] = []  # stages after a process() stage
         self._chain_ts = False  # downstream chain contains event-time windows
@@ -701,6 +732,34 @@ class Runner:
         fed = False
         if self._chain_rows:
             cols, ts, kinds, tables = self._rows_to_cols()
+        elif self._chain_buf and self._multiproc:
+            # multi-host chain hand-off: every process must feed the
+            # IDENTICAL global batch to its (SPMD) downstream stage, so
+            # each step's local rows allgather across processes and then
+            # take the canonical (end, key) order (= the single-chip
+            # fire order). One gather round per buffered step keeps the
+            # collective call count aligned across processes.
+            bufs, self._chain_buf = self._chain_buf, []
+            parts_cols: List[list] = []
+            parts_ts: List[np.ndarray] = []
+            for ecols, eend, ekey in bufs:
+                g = _allgather_rows(list(ecols) + [eend, ekey])
+                gend, gkey = g[-2], g[-1]
+                if not len(gend):
+                    continue
+                o = np.lexsort((gkey, gend))
+                parts_cols.append([c[o] for c in g[:-2]])
+                parts_ts.append(gend[o] - 1)
+            if parts_cols:
+                cols = [
+                    np.concatenate([p[i] for p in parts_cols])
+                    for i in range(len(parts_cols[0]))
+                ]
+                ts = np.concatenate(parts_ts) if self._chain_ts else None
+            else:
+                cols = []
+                ts = None
+            kinds, tables = self.program.out_kinds, self.program.out_tables
         elif self._chain_buf:
             bufs, self._chain_buf = self._chain_buf, []
             cols = [
@@ -893,7 +952,18 @@ class Runner:
                 sel = order[np.nonzero(mask[order])[0]]
             else:
                 sel = np.nonzero(mask)[0]
-            if sel.size:
+            if self._multiproc and self.downstream is not None:
+                # multi-host chain: buffer the LOCAL rows with their
+                # (end, key) order keys, even when this process has none
+                # this step — pump_chain allgathers PER ENTRY, and the
+                # collective call count must match on every process
+                cols = [np.asarray(c)[sel] for c in main["cols"]]
+                self._chain_buf.append((
+                    cols,
+                    np.asarray(main["window_end"])[sel],
+                    np.asarray(main["key"])[sel],
+                ))
+            elif sel.size:
                 cols = [np.asarray(c)[sel] for c in main["cols"]]
                 if self.downstream is not None:
                     # chained stage: hand the columnar emissions straight
@@ -1056,10 +1126,26 @@ def execute_job(env, sink_nodes) -> JobResult:
                 "from a single-host run"
             )
         if chained:
-            raise NotImplementedError(
-                "chained keyed stages are not supported across hosts yet "
-                "(stage hand-off re-batches host-side per process)"
-            )
+            # multi-host hand-off gathers each stage's emissions across
+            # processes in canonical (end, key) order, which needs
+            # window results; rolling/count emissions have no
+            # reconstructible cross-host order, and process()-fed
+            # stages resolve their schema from per-host rows
+            for p in plans[:-1]:
+                st = p.stateful
+                if st is None or st.window is None or not (
+                    st.window.is_time_window() or st.window.kind == "session"
+                ):
+                    raise NotImplementedError(
+                        "multi-host chained stages need a time- or "
+                        "session-window stage before each re-key"
+                    )
+                if st.apply_kind == "process":
+                    raise NotImplementedError(
+                        "multi-host chains fed by a full-window process() "
+                        "stage are not supported (its schema resolves "
+                        "from per-host collected rows)"
+                    )
     if chained and cfg.checkpoint_dir:
         # the downstream schema of a process()-fed stage is resolved
         # adaptively from user-collected rows; snapshotting that
