@@ -276,7 +276,20 @@ PROCESS_JOB_SNIPPET = textwrap.dedent(
 )
 
 
-def _run_two_process_job(tmp_path, snippet):
+_DEFAULT_EPILOGUE = textwrap.dedent(
+    """
+    for r in run_job(lines):
+        print("ROW\\t" + r)
+    print(f"worker {pid}: ok")
+    """
+)
+
+
+def _run_two_process_job(tmp_path, snippet, epilogue=None, extra_argv=()):
+    """Spawn two jax.distributed processes running ``snippet`` +
+    ``epilogue`` over JOB_LINES on stdin; returns (sorted ROW lines,
+    per-process ROW counts). ``extra_argv`` appends to each worker's
+    command line (available as sys.argv[3:])."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -299,13 +312,7 @@ def _run_two_process_job(tmp_path, snippet):
             """
         )
         + snippet
-        + textwrap.dedent(
-            """
-            for r in run_job(lines):
-                print("ROW\\t" + r)
-            print(f"worker {pid}: ok")
-            """
-        )
+        + (epilogue if epilogue is not None else _DEFAULT_EPILOGUE)
     )
     script = tmp_path / "job_worker.py"
     script.write_text(worker)
@@ -317,7 +324,7 @@ def _run_two_process_job(tmp_path, snippet):
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), str(port)],
+            [sys.executable, str(script), str(i), str(port), *extra_argv],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -483,6 +490,82 @@ def test_two_process_chained_job(tmp_path):
     assert expect, "single-process reference produced no output"
     assert got == expect
     assert all(n < len(expect) for n in per_proc), per_proc
+
+
+CKPT_JOB_SNIPPET = textwrap.dedent(
+    """
+    def run_ckpt_job(lines, ckdir=None, restore=None):
+        from tpustream import (
+            BoundedOutOfOrdernessTimestampExtractor,
+            StreamExecutionEnvironment,
+            Time,
+            TimeCharacteristic,
+            Tuple3,
+        )
+        from tpustream.config import StreamConfig
+        from tpustream.runtime.sources import ReplaySource
+
+        class Ts(BoundedOutOfOrdernessTimestampExtractor):
+            def __init__(self):
+                super().__init__(Time.milliseconds(2000))
+
+            def extract_timestamp(self, value):
+                return int(value.split(" ")[0])
+
+        def parse(line):
+            p = line.split(" ")
+            return Tuple3(int(p[0]), p[1], int(p[2]))
+
+        cfg = dict(batch_size=16, key_capacity=64, parallelism=8)
+        if ckdir:
+            cfg.update(checkpoint_dir=ckdir, checkpoint_interval_batches=1)
+        env = StreamExecutionEnvironment(StreamConfig(**cfg))
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        if restore:
+            env.restore_from_checkpoint(restore)
+        text = env.add_source(ReplaySource(lines))
+        handle = (
+            text.assign_timestamps_and_watermarks(Ts())
+            .map(parse)
+            .key_by(1)
+            .time_window(Time.seconds(5))
+            .reduce(lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2))
+            .collect()
+        )
+        env.execute("TwoHostCkptJob")
+        return [repr(t) for t in handle.items]
+    """
+)
+
+
+CKPT_EPILOGUE = textwrap.dedent(
+    """
+    # phase 1: run with per-batch snapshots; phase 2: resume from the
+    # latest one. Per-process exactly-once: the resumed run's emissions
+    # must be exactly the tail of phase 1's.
+    ckdir = sys.argv[3]
+    r1 = run_ckpt_job(lines, ckdir=ckdir)
+    r2 = run_ckpt_job(lines, restore=ckdir)
+    assert len(r2) < len(r1), (len(r1), len(r2))
+    assert r2 == r1[len(r1) - len(r2):], (
+        f"resume is not the exact tail: {r2} vs {r1}"
+    )
+    print(f"worker {pid}: ok")
+    """
+)
+
+
+def test_two_process_checkpoint_resume(tmp_path):
+    """Multi-host checkpoint: sharded leaves gather across processes at
+    snapshot (write on process 0), restore re-places full leaves onto
+    the global mesh; each process's resumed emissions are the exact tail
+    of its original run."""
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    _run_two_process_job(
+        tmp_path, CKPT_JOB_SNIPPET, epilogue=CKPT_EPILOGUE,
+        extra_argv=(str(ckdir),),
+    )
 
 
 def test_two_process_job_matches_single_process(tmp_path):

@@ -47,7 +47,19 @@ _META_KEY = "__meta__"
 
 
 def _leaves(state) -> List[np.ndarray]:
-    return [np.asarray(jax.device_get(l)) for l in jax.tree_util.tree_leaves(state)]
+    """Materialize every state leaf on THIS host. Multi-host meshes hold
+    key-sharded leaves non-addressably; those gather across processes
+    (a DCN collective — every process must call save_checkpoint at the
+    same batch, which the deterministic batch counter guarantees)."""
+    out = []
+    for l in jax.tree_util.tree_leaves(state):
+        if isinstance(l, jax.Array) and not l.is_fully_addressable:
+            from jax.experimental import multihost_utils as mh
+
+            out.append(np.asarray(mh.process_allgather(l, tiled=True)))
+        else:
+            out.append(np.asarray(jax.device_get(l)))
+    return out
 
 
 @dataclass
@@ -136,6 +148,7 @@ class Checkpoint:
             shardings = [NamedSharding(mesh, s) for s in spec_leaves]
         else:
             shardings = [None] * len(t_leaves)
+        multiproc = jax.process_count() > 1
         placed = []
         for saved, like, sharding in zip(self.leaves, t_leaves, shardings):
             if tuple(saved.shape) != tuple(like.shape) or saved.dtype != like.dtype:
@@ -144,9 +157,18 @@ class Checkpoint:
                     f"match program state {like.shape}/{like.dtype} — "
                     "key_capacity / batch_size / window config changed"
                 )
-            placed.append(
-                jax.device_put(saved, sharding) if sharding is not None else saved
-            )
+            if sharding is None:
+                placed.append(saved)
+            elif multiproc:
+                # every process loaded the full leaf (shared storage);
+                # each contributes its addressable slices
+                placed.append(
+                    jax.make_array_from_callback(
+                        saved.shape, sharding, lambda idx, a=saved: a[idx]
+                    )
+                )
+            else:
+                placed.append(jax.device_put(saved, sharding))
         return jax.tree_util.tree_unflatten(treedef, placed)
 
     def restore_tables(self, plan) -> None:
@@ -201,6 +223,10 @@ def save_checkpoint(
     arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
     name = f"ckpt-{batches:010d}.npz"
     path = os.path.join(directory, name)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # the gather above was collective; only the coordinator writes
+        # (snapshots live on shared storage in a real deployment)
+        return path
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:

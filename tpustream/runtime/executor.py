@@ -1120,10 +1120,10 @@ def execute_job(env, sink_nodes) -> JobResult:
     plan = plans[0]
     chained = len(plans) > 1
     if jax.process_count() > 1:
-        if cfg.checkpoint_dir:
+        if cfg.checkpoint_dir and chained:
             raise NotImplementedError(
-                "checkpointing is not supported across hosts yet; snapshot "
-                "from a single-host run"
+                "checkpointing multi-host CHAINED jobs is not supported "
+                "yet; single-stage multi-host jobs checkpoint fine"
             )
         if chained:
             # multi-host hand-off gathers each stage's emissions across
@@ -1273,6 +1273,18 @@ def execute_job(env, sink_nodes) -> JobResult:
             # down the whole chain before the states are captured
             runner.drain_chain(proc_now)
             stages = runner.chain()
+            emitted = metrics.records_emitted
+            if jax.process_count() > 1:
+                # each process emits only its shards' records; the
+                # snapshot records the GLOBAL count (the save is
+                # already a collective, so this gather aligns)
+                from jax.experimental import multihost_utils as mh
+
+                emitted = int(
+                    mh.process_allgather(
+                        np.asarray([emitted], np.int64)
+                    ).sum()
+                )
             save_checkpoint(
                 cfg.checkpoint_dir,
                 state=(
@@ -1283,7 +1295,7 @@ def execute_job(env, sink_nodes) -> JobResult:
                 plan=plan,
                 source_pos=lines_consumed,
                 proc_now=proc_now,
-                emitted=metrics.records_emitted,
+                emitted=emitted,
                 batches=metrics.batches,
                 job_name=env.job_name,
                 parallelism=max(1, cfg.parallelism),
