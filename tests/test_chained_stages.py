@@ -313,3 +313,62 @@ def test_chain_after_process_late_float_fails_loudly():
     )
     with pytest.raises(ValueError, match="fractional"):
         env.execute("late-float")
+
+
+def _late_emission_env(emit):
+    """Two-pump chained-process job: the first fired window freezes the
+    downstream schema from ``emit(1)``, a later pump feeds ``emit(2)``.
+    Returns the env ready to execute (ADVICE r3 schema-guard drives)."""
+    from tpustream import Tuple2
+    from tpustream.api.windows import TumblingProcessingTimeWindows
+
+    def fn(key, ctx, elements, out):
+        out.collect(Tuple2(key, emit(len(list(elements)))))
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=1, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    lines = [
+        "1000 a x 5",
+        "12000 a x 3",     # fires [0,10s): count 1 — schema freezes
+        "13000 a x 7",
+        "26000 a x 9",     # fires [10,20s): count 2 — late emission
+        "40000 a x 1",
+    ]
+    text = env.add_source(ReplaySource(lines))
+    (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .process(fn)
+        .key_by(0)
+        .window(TumblingProcessingTimeWindows.of(Time.minutes(5)))
+        .reduce(lambda p, q: p)
+        .collect()
+    )
+    return env
+
+
+def test_chain_after_process_late_str_after_int_fails_loudly():
+    """A string emission after the schema froze as int must raise the
+    descriptive ValueError, not an opaque numpy TypeError from
+    np.floor on a unicode array."""
+    env = _late_emission_env(lambda n: n if n == 1 else "oops")
+    with pytest.raises(ValueError, match="non-numeric"):
+        env.execute("late-str")
+
+
+def test_chain_after_process_late_int_after_bool_fails_loudly():
+    """An int emission after the schema froze as bool must raise rather
+    than silently coercing 5 -> True."""
+    env = _late_emission_env(lambda n: True if n == 1 else 5)
+    with pytest.raises(ValueError, match="non-bool"):
+        env.execute("late-int-after-bool")
+
+
+def test_chain_after_process_late_str_after_float_fails_loudly():
+    env = _late_emission_env(lambda n: 1.5 if n == 1 else "oops")
+    with pytest.raises(ValueError, match="non-numeric"):
+        env.execute("late-str-after-float")

@@ -68,3 +68,9 @@ def test_resolver_units():
 def test_derived_key_selector_rejected_clearly():
     with pytest.raises(NotImplementedError, match="derived"):
         resolve_key_selector(lambda r: str(r.f0) + "x")
+
+
+def test_bool_key_rejected():
+    # bool subclasses int: key_by(True) must not silently key on field 1
+    with pytest.raises(NotImplementedError):
+        resolve_key_selector(True)

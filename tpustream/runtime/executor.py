@@ -693,31 +693,41 @@ class Runner:
         d = self.downstream
         kinds, tables = d.plan.record_kinds, d.plan.tables
         fields = [_row_fields(r) for r in rows]
+
+        def _bad(i, what, kind, hint=""):
+            # the schema froze at the first pump; a later emission of a
+            # different type would otherwise coerce silently (int ->
+            # True, float -> truncated int) or die in an opaque numpy
+            # TypeError (str under np.floor)
+            raise ValueError(
+                f"chained process() stage emitted a {what} value in "
+                f"field {i} after its schema was inferred as {kind} "
+                f"from earlier rows; emit one consistent type{hint}"
+            )
+
         cols = []
         for i, (k, table) in enumerate(zip(kinds, tables)):
             vs = [f[i] for f in fields]
             if k == STR:
                 cols.append(table.intern_many([str(v) for v in vs]))
-            elif k == "i64":
-                # the schema froze at the first pump; a later float (or
-                # str) emission would otherwise truncate silently
-                arr = np.asarray(vs)
-                if arr.dtype.kind not in "iub" and not np.all(
+                continue
+            if k == "bool":
+                if not all(isinstance(v, (bool, np.bool_)) for v in vs):
+                    _bad(i, "non-bool", "bool")
+                cols.append(np.asarray(vs, dtype=np.bool_))
+                continue
+            arr = np.asarray(vs)
+            if arr.dtype.kind not in "iubf":
+                _bad(i, "non-numeric", "int" if k == "i64" else "float")
+            if k == "i64":
+                if arr.dtype.kind == "f" and not np.all(
                     arr == np.floor(arr)
                 ):
-                    raise ValueError(
-                        f"chained process() stage emitted a fractional "
-                        f"value in field {i} after its schema was "
-                        f"inferred as int from earlier rows; emit one "
-                        f"consistent type (e.g. always float)"
-                    )
+                    _bad(i, "fractional", "int",
+                         " (e.g. always float)")
                 cols.append(arr.astype(np.int64))
             else:
-                cols.append(
-                    np.asarray(vs, dtype={
-                        "f64": np.float64, "bool": np.bool_,
-                    }[k])
-                )
+                cols.append(arr.astype(np.float64))
         self._chain_rows = []
         return cols, ts, kinds, tables
 

@@ -70,7 +70,9 @@ def resolve_key_selector(key: Any) -> int:
     COMPUTE a derived key would need a device-traced key column and are
     rejected with a clear error.
     """
-    if isinstance(key, int):
+    # bool is an int subclass: key_by(True) would silently key on field
+    # 1 — reject it as a non-selector instead
+    if isinstance(key, int) and not isinstance(key, bool):
         return key
     # probe every plausible entry point: a KeySelector subclass may
     # override either get_key or the Flink-style getKey alias (the
