@@ -75,6 +75,12 @@ class Checkpoint:
     batches: int
     job_name: Optional[str] = None
     parallelism: int = 1             # mesh shards at snapshot time
+    # per lazily-built chain stage (in chain order): the record schema a
+    # process()-fed downstream had inferred from collected rows at
+    # snapshot time — {"kinds": [...], "tables": [state_dict|None]}.
+    # A restored run rebuilds those stages eagerly from this instead of
+    # waiting for (already-consumed) rows to re-infer from.
+    lazy_schemas: Optional[list] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -203,6 +209,7 @@ def save_checkpoint(
     job_name: Optional[str] = None,
     parallelism: int = 1,
     keep: int = 3,
+    lazy_schemas: Optional[list] = None,
 ) -> str:
     """Snapshot to ``directory/ckpt-<batches>.npz`` (atomic rename); prunes
     to the ``keep`` newest snapshots and refreshes ``latest`` marker."""
@@ -219,6 +226,7 @@ def save_checkpoint(
         "batches": int(batches),
         "job_name": job_name,
         "parallelism": int(parallelism),
+        "lazy_schemas": lazy_schemas or [],
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
     name = f"ckpt-{batches:010d}.npz"
@@ -294,4 +302,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         batches=meta["batches"],
         job_name=meta.get("job_name"),
         parallelism=meta.get("parallelism", 1),
+        lazy_schemas=meta.get("lazy_schemas", []),
     )

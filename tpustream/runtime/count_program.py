@@ -492,10 +492,15 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
             fired += 1
             out = Collector()
             self.process_fn(key_val, ctx, elements, out)
-            for item in out.items:
+            for ii, item in enumerate(out.items):
                 item, keep = run_post_ops(item, post_ops)
                 if keep:
-                    emit(item, key_id % max(1, self.n_shards))
+                    # order: the closing record's global arrival index
+                    # (unique per fire, identical meaning on every
+                    # process) + item ordinal — the multi-host chain
+                    # merge sorts by it
+                    emit(item, key_id % max(1, self.n_shards),
+                         order=(int(arr[r]), ii))
                     emitted += 1
         return emitted, fired
 

@@ -385,7 +385,10 @@ class Runner:
         # ts extraction happen after the cross-process gather
         self.downstream: Optional["Runner"] = None
         self._chain_buf: List[tuple] = []
-        self._chain_rows: List[tuple] = []  # (item, ts) from process() fires
+        # (item, ts, order) from process() fires; order is the
+        # evaluation-loop position (used only for the multi-host merge)
+        self._chain_rows: List[tuple] = []
+        self._dispatch_seq = 0
         self._lazy_plans: List[JobPlan] = []  # stages after a process() stage
         self._chain_ts = False  # downstream chain contains event-time windows
         self.count_input = True
@@ -670,11 +673,14 @@ class Runner:
         truncate the floats."""
         from ..records import StringTable
 
-        kinds = _infer_row_kinds([item for item, _ in self._chain_rows])
+        kinds = _infer_row_kinds([item for item, _, _ in self._chain_rows])
         p2 = self._lazy_plans[0]
         p2.record_kinds.extend(kinds)
         p2.tables.extend(StringTable() if k == STR else None for k in kinds)
         d = _make_runner_chain(self._lazy_plans, self.cfg, self.metrics)
+        # the inferred schema is snapshotted with checkpoints so a
+        # restored run can rebuild this runner without re-inference
+        d._lazy_schema = True
         self._lazy_plans = []
         self.chain_to(d)
         _wire_chain_ts(self, d)
@@ -684,9 +690,9 @@ class Runner:
         """Convert buffered process() rows to the downstream's columnar
         schema (established at lazy build; values coerce to the widened
         plan kinds)."""
-        rows = [item for item, _ in self._chain_rows]
+        rows = [item for item, _, _ in self._chain_rows]
         ts = (
-            np.asarray([t for _, t in self._chain_rows], dtype=np.int64)
+            np.asarray([t for _, t, _ in self._chain_rows], dtype=np.int64)
             if self._chain_ts
             else None
         )
@@ -716,8 +722,12 @@ class Runner:
                     _bad(i, "non-bool", "bool")
                 cols.append(np.asarray(vs, dtype=np.bool_))
                 continue
+            if any(isinstance(v, (bool, np.bool_)) for v in vs):
+                # np.asarray would fold True into 1/1.0 with no error —
+                # the same silent-coercion class the bool branch rejects
+                _bad(i, "bool", "int" if k == "i64" else "float")
             arr = np.asarray(vs)
-            if arr.dtype.kind not in "iubf":
+            if arr.dtype.kind not in "iuf":
                 _bad(i, "non-numeric", "int" if k == "i64" else "float")
             if k == "i64":
                 if arr.dtype.kind == "f" and not np.all(
@@ -731,10 +741,54 @@ class Runner:
         self._chain_rows = []
         return cols, ts, kinds, tables
 
+    def _gather_chain_rows(self):
+        """Multi-host process()-fed chain hand-off: allgather every
+        process's locally-evaluated fire rows (pickled — rows are user
+        objects) and merge them in the single-process evaluation order
+        (each row carries its evaluation-loop position). After this,
+        every process holds the IDENTICAL global row list, so schema
+        inference and the downstream SPMD feed agree everywhere.
+
+        Called once per pump on every process (the pump cadence is
+        driven by source batches, which replay identically), keeping the
+        collective call count aligned even when only one side fired."""
+        import pickle
+
+        from jax.experimental import multihost_utils as mh
+
+        # most pumps fire nothing anywhere: settle that with one scalar
+        # gather (SPMD-identical result, so every process skips the blob
+        # gather together — collective counts stay aligned)
+        n_rows = mh.process_allgather(
+            np.asarray([len(self._chain_rows)], np.int64)
+        ).reshape(-1)
+        if not int(n_rows.sum()):
+            return
+        blob = np.frombuffer(
+            pickle.dumps(self._chain_rows), dtype=np.uint8
+        )
+        counts = mh.process_allgather(
+            np.asarray([blob.shape[0]], np.int64)
+        ).reshape(-1)
+        mx = int(counts.max())
+        pad = np.zeros(mx - blob.shape[0], np.uint8)
+        g = mh.process_allgather(np.concatenate([blob, pad]))
+        merged = []
+        for p in range(g.shape[0]):
+            merged.extend(pickle.loads(g[p, : int(counts[p])].tobytes()))
+        merged.sort(key=lambda e: e[2])
+        self._chain_rows = merged
+
     def pump_chain(self, proc_now: int):
         """Move buffered emissions to the downstream runner (or tick its
         processing-time clock when there are none), then cascade."""
         d = self.downstream
+        if (
+            self._multiproc
+            and getattr(self.program, "host_evaluated", False)
+            and (d is not None or self._lazy_plans)
+        ):
+            self._gather_chain_rows()
         if d is None and self._chain_rows and self._lazy_plans:
             d = self._build_lazy_downstream()
         if d is None:
@@ -746,20 +800,36 @@ class Runner:
             # multi-host chain hand-off: every process must feed the
             # IDENTICAL global batch to its (SPMD) downstream stage, so
             # each step's local rows allgather across processes and then
-            # take the canonical (end, key) order (= the single-chip
-            # fire order). One gather round per buffered step keeps the
-            # collective call count aligned across processes.
+            # take the canonical order — (end, key) for window stages
+            # (= the single-chip fire order), the global post-exchange
+            # row index for rolling/count stages (= the single-process
+            # emission order). One gather round per buffered step keeps
+            # the collective call count aligned across processes.
             bufs, self._chain_buf = self._chain_buf, []
             parts_cols: List[list] = []
             parts_ts: List[np.ndarray] = []
-            for ecols, eend, ekey in bufs:
-                g = _allgather_rows(list(ecols) + [eend, ekey])
-                gend, gkey = g[-2], g[-1]
-                if not len(gend):
-                    continue
-                o = np.lexsort((gkey, gend))
-                parts_cols.append([c[o] for c in g[:-2]])
-                parts_ts.append(gend[o] - 1)
+            for entry in bufs:
+                if entry[0] == "win":
+                    _, ecols, eend, ekey = entry
+                    g = _allgather_rows(list(ecols) + [eend, ekey])
+                    gend, gkey = g[-2], g[-1]
+                    if not len(gend):
+                        continue
+                    o = np.lexsort((gkey, gend))
+                    parts_cols.append([c[o] for c in g[:-2]])
+                    parts_ts.append(gend[o] - 1)
+                else:  # "arr"
+                    _, ecols, gorder, ets = entry
+                    nc = len(ecols)
+                    extra = [gorder] + ([ets] if ets is not None else [])
+                    g = _allgather_rows(list(ecols) + extra)
+                    go = g[nc]
+                    if not len(go):
+                        continue
+                    o = np.argsort(go, kind="stable")
+                    parts_cols.append([c[o] for c in g[:nc]])
+                    if ets is not None:
+                        parts_ts.append(g[-1][o])
             if parts_cols:
                 cols = [
                     np.concatenate([p[i] for p in parts_cols])
@@ -924,13 +994,19 @@ class Runner:
             if int(jax.device_get(self.state["pending_fires"])) == 0:
                 break
 
-    def _emit_row(self, row, subtask, ts=None):
+    def _emit_row(self, row, subtask, ts=None, order=None):
         """Fan one emitted record out to every branch: apply the
         branch's host-side map/filter tail, then its sink. Chained
-        process() stages buffer the row (with its window timestamp)
-        for the downstream runner instead."""
+        process() stages buffer the row (with its window timestamp and
+        — for the multi-host cross-process merge — the evaluation-loop
+        order key the program supplied) for the downstream runner."""
         if self.downstream is not None or self._lazy_plans:
-            self._chain_rows.append((row, ts))
+            o = (
+                None
+                if order is None
+                else (self._dispatch_seq,) + tuple(order)
+            )
+            self._chain_rows.append((row, ts, o))
             return
         for ops, sink in self.sinks:
             item, keep = _apply_ops(ops, row)
@@ -938,6 +1014,11 @@ class Runner:
                 sink.emit(item, subtask=subtask)
 
     def _dispatch(self, emissions, t_batch=None):
+        # step epoch for host-evaluated fire ordering: the per-step
+        # dispatch sequence is SPMD-identical across processes (the
+        # fetch decision keys on GLOBAL emission counts), so it is a
+        # valid leading component of the cross-process merge key
+        self._dispatch_seq += 1
         emitted_before = self.metrics.records_emitted
         chained = self.downstream is not None or self._lazy_plans
         fire_info = emissions.get("process_fire")
@@ -954,25 +1035,44 @@ class Runner:
             order = main.get("order")
             if order is not None:
                 # device emitted rows in its internal (sorted) order;
-                # order[j] is arrival row j's position — un-permute HERE,
-                # off the device critical path (numpy gather). Order
-                # values address the GLOBAL stacked buffer; under
+                # order[j] is post-exchange row j's position — un-permute
+                # HERE, off the device critical path (numpy gather).
+                # Order values address the GLOBAL stacked buffer; under
                 # multi-host each process fetched only its slice.
                 order = np.asarray(order) - self._local_row_base(mask.shape[0])
-                sel = order[np.nonzero(mask[order])[0]]
+                j_valid = np.nonzero(mask[order])[0]
+                sel = order[j_valid]
             else:
+                j_valid = None
                 sel = np.nonzero(mask)[0]
             if self._multiproc and self.downstream is not None:
                 # multi-host chain: buffer the LOCAL rows with their
-                # (end, key) order keys, even when this process has none
+                # global order keys, even when this process has none
                 # this step — pump_chain allgathers PER ENTRY, and the
-                # collective call count must match on every process
+                # collective call count must match on every process.
+                # Window stages order by (end, key); rolling/count
+                # stages order by global post-exchange row index, which
+                # reconstructs the single-process hand-off order (each
+                # process's rows ARE its shards' region of the global
+                # row space).
                 cols = [np.asarray(c)[sel] for c in main["cols"]]
-                self._chain_buf.append((
-                    cols,
-                    np.asarray(main["window_end"])[sel],
-                    np.asarray(main["key"])[sel],
-                ))
+                wend = main.get("window_end")
+                if wend is not None:
+                    self._chain_buf.append(("win", cols,
+                        np.asarray(wend)[sel],
+                        np.asarray(main["key"])[sel],
+                    ))
+                else:
+                    gorder = (
+                        j_valid + self._local_row_base(order.shape[0])
+                    ).astype(np.int64)
+                    tsarr = main.get("ts")
+                    ets = (
+                        np.asarray(tsarr)[sel]
+                        if (self._chain_ts and tsarr is not None)
+                        else None
+                    )
+                    self._chain_buf.append(("arr", cols, gorder, ets))
             elif sel.size:
                 cols = [np.asarray(c)[sel] for c in main["cols"]]
                 if self.downstream is not None:
@@ -1086,17 +1186,38 @@ def _wire_chain_ts(up: Runner, down: Runner):
         up.program.emit_ts = True  # read at trace time (first batch)
 
 
-def _make_runner_chain(plans, cfg, metrics) -> Runner:
+def _make_runner_chain(plans, cfg, metrics, lazy_schemas=None) -> Runner:
     """Build the runner for plans[0] plus downstream runners for any
     chained stages, wiring record schemas from each upstream program.
 
     A stage fed by a full-window process() stage resolves its schema
     from the user function's first collected rows (the function may emit
-    any shape), so its runner is built lazily on the first pump."""
+    any shape), so its runner is built lazily on the first pump — unless
+    ``lazy_schemas`` (checkpoint restore) supplies the schema each such
+    stage had already inferred, in which case the full chain builds
+    eagerly with the snapshotted kinds/tables."""
+    from ..records import StringTable
+
+    lazy_schemas = list(lazy_schemas or [])
     runner = Runner(plans[0], cfg, metrics)
     up = runner
     for i, p2 in enumerate(plans[1:], start=1):
         if getattr(up.program, "host_evaluated", False):
+            if lazy_schemas:
+                saved = lazy_schemas.pop(0)
+                p2.record_kinds.extend(saved["kinds"])
+                for t in saved["tables"]:
+                    if t is None:
+                        p2.tables.append(None)
+                    else:
+                        table = StringTable()
+                        table.load_state_dict(t)
+                        p2.tables.append(table)
+                r2 = Runner(p2, cfg, metrics)
+                r2._lazy_schema = True
+                up.chain_to(r2)
+                up = r2
+                continue
             up._lazy_plans = list(plans[i:])
             up._chain_ts = _chain_needs_event_ts(up._lazy_plans)
             if up._chain_ts:
@@ -1129,44 +1250,6 @@ def execute_job(env, sink_nodes) -> JobResult:
     plans = build_plan_chain(env, sink_nodes)
     plan = plans[0]
     chained = len(plans) > 1
-    if jax.process_count() > 1:
-        if cfg.checkpoint_dir and chained:
-            raise NotImplementedError(
-                "checkpointing multi-host CHAINED jobs is not supported "
-                "yet; single-stage multi-host jobs checkpoint fine"
-            )
-        if chained:
-            # multi-host hand-off gathers each stage's emissions across
-            # processes in canonical (end, key) order, which needs
-            # window results; rolling/count emissions have no
-            # reconstructible cross-host order, and process()-fed
-            # stages resolve their schema from per-host rows
-            for p in plans[:-1]:
-                st = p.stateful
-                if st is None or st.window is None or not (
-                    st.window.is_time_window() or st.window.kind == "session"
-                ):
-                    raise NotImplementedError(
-                        "multi-host chained stages need a time- or "
-                        "session-window stage before each re-key"
-                    )
-                if st.apply_kind == "process":
-                    raise NotImplementedError(
-                        "multi-host chains fed by a full-window process() "
-                        "stage are not supported (its schema resolves "
-                        "from per-host collected rows)"
-                    )
-    if chained and cfg.checkpoint_dir:
-        # the downstream schema of a process()-fed stage is resolved
-        # adaptively from user-collected rows; snapshotting that
-        # adaptive schema is not supported (every other chain shape is)
-        for p in plans[:-1]:
-            if p.stateful is not None and p.stateful.apply_kind == "process":
-                raise NotImplementedError(
-                    "checkpointing a chain fed by a full-window process() "
-                    "stage is not supported (its record schema is "
-                    "resolved adaptively from collected rows)"
-                )
     host = HostStage(plan, cfg)
     metrics = Metrics()
     runner: Optional[Runner] = None
@@ -1181,7 +1264,9 @@ def execute_job(env, sink_nodes) -> JobResult:
 
         ck = load_checkpoint(restore_path)
         ck.restore_tables(plan)
-        runner = _make_runner_chain(plans, cfg, metrics)
+        runner = _make_runner_chain(
+            plans, cfg, metrics, lazy_schemas=ck.lazy_schemas
+        )
         stages = runner.chain()
         states = ck.restore_chain([r.program for r in stages])
         for r, s in zip(stages, states):
@@ -1256,8 +1341,15 @@ def execute_job(env, sink_nodes) -> JobResult:
         if batch is not None:
             if runner is None:
                 runner = _make_runner_chain(plans, cfg, metrics)
+            # multi-host: the idle test is LOCAL wall clock, so one
+            # process could drain (appending chain-buffer entries and
+            # issuing gathers) while its peer keeps the step in flight —
+            # a collective-sequence mismatch. Multi-host runs keep the
+            # deterministic pipelined path instead.
             idle = (
-                t_last_feed is not None and hw.t0 - t_last_feed > IDLE_GAP_S
+                jax.process_count() == 1
+                and t_last_feed is not None
+                and hw.t0 - t_last_feed > IDLE_GAP_S
             )
             t_last_feed = hw.t0
             runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
@@ -1295,8 +1387,20 @@ def execute_job(env, sink_nodes) -> JobResult:
                         np.asarray([emitted], np.int64)
                     ).sum()
                 )
+            lazy_schemas = [
+                {
+                    "kinds": list(r.plan.record_kinds),
+                    "tables": [
+                        t.state_dict() if t is not None else None
+                        for t in r.plan.tables
+                    ],
+                }
+                for r in stages
+                if getattr(r, "_lazy_schema", False)
+            ]
             save_checkpoint(
                 cfg.checkpoint_dir,
+                lazy_schemas=lazy_schemas,
                 state=(
                     [r.state for r in stages]
                     if len(stages) > 1

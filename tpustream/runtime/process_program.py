@@ -338,11 +338,20 @@ class ProcessWindowProgram(WindowProgram):
                 fired += 1
                 out = Collector()
                 self.process_fn(key_val, ctx, elements, out)
-                for item in out.items:
+                for ii, item in enumerate(out.items):
                     item, keep = run_post_ops(item, post_ops)
                     if keep:
                         # third arg: Flink's window result timestamp
-                        # (end - 1), consumed by chained stages
-                        emit(item, key_id % S, int(ends[j]) - 1)
+                        # (end - 1), consumed by chained stages. The
+                        # order tuple (fire candidate, global stacked
+                        # key row, item ordinal) is this emission's
+                        # position in the single-process evaluation
+                        # loop — the multi-host chain merge sorts by it.
+                        emit(item, key_id % S, int(ends[j]) - 1,
+                             order=(
+                                 int(j),
+                                 shard_base * k_local + int(key_row),
+                                 ii,
+                             ))
                         emitted += 1
         return emitted, fired

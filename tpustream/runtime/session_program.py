@@ -687,12 +687,20 @@ class SessionProcessProgram(ProcessWindowProgram):
                 fired += 1
                 out = Collector()
                 self.process_fn(key_val, ctx, elements, out)
-                for item in out.items:
+                for ii, item in enumerate(out.items):
                     item, keep = run_post_ops(item, post_ops)
                     if keep:
                         # session result timestamp = end - 1 (Flink),
-                        # consumed by chained stages
+                        # consumed by chained stages. The order tuple is
+                        # this emission's position in the single-process
+                        # evaluation loop (global stacked key row,
+                        # session ordinal, item ordinal) — the
+                        # multi-host chain merge sorts by it.
                         emit(item, key_id % max(1, self.n_shards),
-                             end_ts + gap - 1)
+                             end_ts + gap - 1,
+                             order=(
+                                 shard_base * k_local + int(key_row),
+                                 int(os_), ii,
+                             ))
                         emitted += 1
         return emitted, fired
