@@ -377,17 +377,132 @@ def test_count_window_resume(tmp_path):
     assert [(t.f0, t.f1) for t in full] == [("a", 7.0), ("b", 60.0), ("a", 56.0)]
 
 
-def test_restore_rejects_parallelism_mismatch(tmp_path):
-    """Sharded keyed state is laid out shard-major: restoring under a
-    different parallelism must fail loudly, not silently mis-key."""
+# ---------------------------------------------------------------------------
+# Checkpoint RESCALE (VERDICT r3 next #2): a snapshot written at
+# parallelism N restores at parallelism M — keyed state rows permute
+# through the canonical key-major order onto the target's shard-major
+# layout (Flink savepoints rescale the same way). The resumed run must
+# emit exactly the remaining records, independent of the new layout.
+# ---------------------------------------------------------------------------
+def rescale_check(
+    build, items, tmp_path, p_save, p_resume, time_char=None, **cfg
+):
+    cfg.setdefault("batch_size", 16)
+    cfg.setdefault("key_capacity", 64)
+    cfg.setdefault("print_parallelism", 1)
+    full = run_job(
+        build, items, time_char=time_char, parallelism=p_save, **cfg
+    )
+    assert full, "job produced no output"
+    ckdir = tmp_path / "ck"
+    with_ck = run_job(
+        build, items, tmpdir=ckdir, time_char=time_char,
+        parallelism=p_save, **cfg,
+    )
+    assert sorted(map(repr, with_ck)) == sorted(map(repr, full))
+    snaps = checkpoints(ckdir)
+    assert snaps, "no checkpoints were written"
+    resumed_mid = False
+    for snap in snaps:
+        ck = load_checkpoint(snap)
+        resumed = run_job(
+            build, items, restore=snap, time_char=time_char,
+            parallelism=p_resume, **cfg,
+        )
+        # emission ORDER is parallelism-dependent (per-shard emission
+        # buffers stack); the exactly-once multiset is not
+        assert sorted(map(repr, resumed)) == sorted(
+            map(repr, full[ck.emitted :])
+        ), f"rescued tail mismatch resuming {snap} at p={p_resume}"
+        resumed_mid = resumed_mid or 0 < ck.emitted < len(full)
+    return resumed_mid
+
+
+def test_rescale_rolling_state(tmp_path):
     from tpustream.jobs.chapter2_max import build
 
-    lines = [f"15634520{i:02d} 10.8.22.{i % 5} cpu0 {50 + i}.0" for i in range(16)]
-    ckdir = tmp_path / "ck"
-    run_job(build, lines, tmpdir=ckdir, **sharded_cfg())
-    snap = checkpoints(ckdir)[-1]
-    with pytest.raises(ValueError, match="parallelism"):
-        run_job(
-            build, lines, restore=snap,
-            parallelism=4, batch_size=16, key_capacity=64, print_parallelism=1,
+    lines = [
+        f"15634520{i:02d} 10.8.22.{i % 11} cpu{i % 3} {40 + (i * 13) % 60}.5"
+        for i in range(24)
+    ]
+    assert rescale_check(build, lines, tmp_path / "up", 1, 8)
+    assert rescale_check(build, lines, tmp_path / "down", 8, 1)
+
+
+def test_rescale_eventtime_window_state(tmp_path):
+    """Window word planes are FLAT [shard][slot][local_key] arrays —
+    the rescale permutes through [slot][global_key]."""
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        Time,
+        Tuple2,
+    )
+
+    class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(2_000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    def build(env, text):
+        return (
+            text.assign_timestamps_and_watermarks(TsExtractor())
+            .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .time_window(Time.seconds(5))
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
         )
+
+    lines = [
+        f"{1000 + i * 700} k{i % 9} {i + 1}" for i in range(24)
+    ]
+    assert rescale_check(
+        build, lines, tmp_path / "up", 1, 8,
+        time_char=TimeCharacteristic.EventTime,
+    )
+    assert rescale_check(
+        build, lines, tmp_path / "down", 8, 1,
+        time_char=TimeCharacteristic.EventTime,
+    )
+
+
+def test_rescale_session_state(tmp_path):
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        Time,
+        Tuple2,
+    )
+    from tpustream.api.windows import EventTimeSessionWindows
+
+    class TsExtractor(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(2_000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    def build(env, text):
+        return (
+            text.assign_timestamps_and_watermarks(TsExtractor())
+            .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .window(EventTimeSessionWindows.with_gap(Time.seconds(4)))
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        )
+
+    lines = [
+        "1000 a 1", "2000 b 2", "3000 a 4", "9000 b 8",
+        "20000 a 16",   # closes the first a/b sessions
+        "22000 b 32", "23000 a 64",
+        "40000 c 100",  # closes the 20-23s sessions
+        "55000 c 200",
+    ]
+    assert rescale_check(
+        build, lines, tmp_path / "up", 1, 8,
+        time_char=TimeCharacteristic.EventTime, alert_capacity=1024,
+    )
+    assert rescale_check(
+        build, lines, tmp_path / "down", 8, 1,
+        time_char=TimeCharacteristic.EventTime, alert_capacity=1024,
+    )

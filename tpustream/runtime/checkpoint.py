@@ -120,16 +120,14 @@ class Checkpoint:
         than as silent corruption later.
         """
         # the sharded key layout is shard-major (row shard*k_local+r holds
-        # global key r*S+shard), so global shapes match across parallelism
-        # values while the layout does not — refuse the silent corruption
+        # global key r*S+shard), so global SHAPES match across parallelism
+        # values while the layout does not. A snapshot written at a
+        # different parallelism RESCALES: each key-sharded leaf permutes
+        # through the canonical key-major order onto this program's
+        # layout (Flink savepoints restore at any parallelism; the
+        # program supplies the per-layout restack via rescale_key_leaf).
         prog_par = max(1, getattr(program, "n_shards", 1))
-        if self.parallelism != prog_par:
-            raise ValueError(
-                f"checkpoint was written at parallelism={self.parallelism} "
-                f"but the job runs at parallelism={prog_par} — keyed state "
-                "rows are laid out shard-major and cannot be re-mapped; "
-                "resume with the original parallelism"
-            )
+        rescale = self.parallelism != prog_par
         target = program.init_state()
         t_leaves, treedef = jax.tree_util.tree_flatten(target)
         if len(t_leaves) != len(self.leaves):
@@ -138,6 +136,12 @@ class Checkpoint:
                 f"program expects {len(t_leaves)} — job graph or config "
                 "changed since the snapshot"
             )
+        from ..parallel.mesh import AXIS
+
+        spec_leaves = jax.tree_util.tree_leaves(
+            program.state_specs(target),
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
         # mesh programs: place each leaf onto its state_specs sharding
         # (key-axis leaves split over shards, scalars replicate) so the
         # restored pytree enters the shard_map step exactly like a fresh
@@ -147,22 +151,22 @@ class Checkpoint:
         if mesh is not None:
             from jax.sharding import NamedSharding
 
-            spec_leaves = jax.tree_util.tree_leaves(
-                program.state_specs(target),
-                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
-            )
             shardings = [NamedSharding(mesh, s) for s in spec_leaves]
         else:
             shardings = [None] * len(t_leaves)
         multiproc = jax.process_count() > 1
         placed = []
-        for saved, like, sharding in zip(self.leaves, t_leaves, shardings):
+        for saved, like, spec, sharding in zip(
+            self.leaves, t_leaves, spec_leaves, shardings
+        ):
             if tuple(saved.shape) != tuple(like.shape) or saved.dtype != like.dtype:
                 raise ValueError(
                     f"checkpoint leaf {saved.shape}/{saved.dtype} does not "
                     f"match program state {like.shape}/{like.dtype} — "
                     "key_capacity / batch_size / window config changed"
                 )
+            if rescale and len(spec) and spec[0] == AXIS:
+                saved = program.rescale_key_leaf(saved, self.parallelism)
             if sharding is None:
                 placed.append(saved)
             elif multiproc:

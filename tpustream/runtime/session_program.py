@@ -44,6 +44,7 @@ from ..ops.panes import W0
 from ..ops.sessions import TS_MAX
 from .plan import JobPlan
 from .process_program import ProcessWindowProgram, run_post_ops
+from .step import BaseProgram
 from .window_program import WindowProgram
 
 
@@ -109,9 +110,11 @@ class SessionWindowProgram(WindowProgram):
     def state_specs(self, state):
         # typed [K, N] cells shard on the KEY axis (axis 0), unlike the
         # word-plane layout of WindowProgram
-        from .step import BaseProgram
-
         return BaseProgram.state_specs(self, state)
+
+    # leading-key leaves rescale with the base restack, not the flat
+    # word-plane one
+    rescale_key_leaf = BaseProgram.rescale_key_leaf
 
     # ------------------------------------------------------------------
     def _scatter_session(self, state, keys, mid_cols, live, pane, ts):

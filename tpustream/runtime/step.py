@@ -67,6 +67,38 @@ class BaseProgram:
             lambda leaf: P(AXIS) if leaf.ndim >= 2 else P(), state
         )
 
+    def rescale_key_leaf(self, arr: np.ndarray, from_parallelism: int):
+        """Re-lay a key-sharded state leaf saved at a different
+        parallelism onto THIS program's shard-major layout (checkpoint
+        rescale — Flink savepoints restore at any parallelism).
+
+        Default layout: leading key axis stacked shard-major, row
+        ``shard * k_local + local`` holding global key
+        ``local * S + shard``. The global shape is parallelism-
+        independent, so rescale is a pure row permutation through the
+        canonical key-major order. WindowProgram overrides for its flat
+        word-plane layout."""
+        S_o = max(1, from_parallelism)
+        S_n = max(1, self.n_shards)
+        if S_o == S_n:
+            return arr
+        K = arr.shape[0]
+        if K % S_o or K % S_n:
+            raise ValueError(
+                f"cannot rescale keyed state: key_capacity ({K}) must "
+                f"divide evenly by both the snapshot parallelism ({S_o}) "
+                f"and the target parallelism ({S_n})"
+            )
+        rest = tuple(range(2, arr.ndim + 1))
+        canon = arr.reshape(S_o, K // S_o, *arr.shape[1:]).transpose(
+            1, 0, *rest
+        ).reshape(arr.shape)
+        return np.ascontiguousarray(
+            canon.reshape(K // S_n, S_n, *arr.shape[1:]).transpose(
+                1, 0, *rest
+            ).reshape(arr.shape)
+        )
+
     # False for programs with no time semantics (per-record rolling,
     # count windows, stateless chains): a clock tick / EOS flush step can
     # never produce output for them, so the executor skips it

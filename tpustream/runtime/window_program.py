@@ -335,6 +335,29 @@ class WindowProgram(BaseProgram):
         specs["cnt"] = P(AXIS)
         return specs
 
+    def rescale_key_leaf(self, arr, from_parallelism: int):
+        """Checkpoint rescale for the FLAT word planes: the global flat
+        layout is ``[shard][slot][local_key]`` (one contiguous
+        ``[n_slots * k_local]`` block per shard), so the permutation
+        routes through a canonical ``[slot][global_key]`` intermediate
+        rather than the leading-key restack of the base layout."""
+        S_o = max(1, from_parallelism)
+        S_n = max(1, self.n_shards)
+        if S_o == S_n:
+            return arr
+        n = self.ring.n_slots
+        K = arr.shape[0] // n
+        if K % S_o or K % S_n:
+            raise ValueError(
+                f"cannot rescale window state: key_capacity ({K}) must "
+                f"divide evenly by both the snapshot parallelism ({S_o}) "
+                f"and the target parallelism ({S_n})"
+            )
+        canon = arr.reshape(S_o, n, K // S_o).transpose(1, 2, 0).reshape(n, K)
+        return np.ascontiguousarray(
+            canon.reshape(n, K // S_n, S_n).transpose(2, 0, 1).reshape(-1)
+        )
+
     # ------------------------------------------------------------------
     def init_state(self):
         # planes live FLAT (cell = slot * keys + key): reshape wrappers
