@@ -114,8 +114,16 @@ def test_restore_rejects_config_mismatch(tmp_path):
     ckdir = tmp_path / "ck"
     run_job(build, lines, tmpdir=ckdir)
     snap = checkpoints(ckdir)[0]
+    # a config that changes leaf DTYPES is a real mismatch...
     with pytest.raises(ValueError, match="does not match|state arrays"):
-        run_job(build, lines, restore=snap, key_capacity=2048)
+        run_job(build, lines, restore=snap, acc_dtype="float32")
+    # ...but a different key_capacity is not: the snapshot records the
+    # effective capacity and the restored runner rebuilds to match
+    # (dynamic key growth means capacity is not identity-defining)
+    full = run_job(build, lines)
+    ck = load_checkpoint(snap)
+    resumed = run_job(build, lines, restore=snap, key_capacity=2048)
+    assert resumed == full[ck.emitted :]
 
 
 def test_load_latest_from_directory(tmp_path):

@@ -20,7 +20,9 @@ class StreamConfig:
     max_batch_delay_ms: float = 5.0   # max host-side wait to fill a batch
 
     # -- keyed state --------------------------------------------------------
-    key_capacity: int = 1024          # dense keyed-state slots per job
+    key_capacity: int = 1024          # INITIAL dense keyed-state slots;
+                                      # grows 2x (one recompile) when the
+                                      # distinct-key count passes it
                                       # (bench configs raise to >=1<<20)
 
     # -- windows ------------------------------------------------------------

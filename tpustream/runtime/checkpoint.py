@@ -81,6 +81,11 @@ class Checkpoint:
     # A restored run rebuilds those stages eagerly from this instead of
     # waiting for (already-consumed) rows to re-infer from.
     lazy_schemas: Optional[list] = None
+    # per built chain stage: the key capacity the stage was running at —
+    # dynamic growth may have doubled it past StreamConfig.key_capacity,
+    # and the restored runners must be rebuilt to match before their
+    # state leaves place
+    key_capacities: Optional[list] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -159,14 +164,26 @@ class Checkpoint:
         for saved, like, spec, sharding in zip(
             self.leaves, t_leaves, spec_leaves, shardings
         ):
+            key_sharded = len(spec) and spec[0] == AXIS
+            if rescale and key_sharded:
+                saved = program.rescale_key_leaf(saved, self.parallelism)
+            if (
+                key_sharded
+                and saved.shape[0] < like.shape[0]
+                and tuple(saved.shape[1:]) == tuple(like.shape[1:])
+            ):
+                # restoring into a LARGER key capacity (the run was
+                # configured above the snapshot's effective capacity):
+                # grow the saved rows into the bigger layout
+                saved = program.grow_key_leaf(
+                    saved, np.asarray(jax.device_get(like))
+                )
             if tuple(saved.shape) != tuple(like.shape) or saved.dtype != like.dtype:
                 raise ValueError(
                     f"checkpoint leaf {saved.shape}/{saved.dtype} does not "
                     f"match program state {like.shape}/{like.dtype} — "
                     "key_capacity / batch_size / window config changed"
                 )
-            if rescale and len(spec) and spec[0] == AXIS:
-                saved = program.rescale_key_leaf(saved, self.parallelism)
             if sharding is None:
                 placed.append(saved)
             elif multiproc:
@@ -214,6 +231,7 @@ def save_checkpoint(
     parallelism: int = 1,
     keep: int = 3,
     lazy_schemas: Optional[list] = None,
+    key_capacities: Optional[list] = None,
 ) -> str:
     """Snapshot to ``directory/ckpt-<batches>.npz`` (atomic rename); prunes
     to the ``keep`` newest snapshots and refreshes ``latest`` marker."""
@@ -231,6 +249,7 @@ def save_checkpoint(
         "job_name": job_name,
         "parallelism": int(parallelism),
         "lazy_schemas": lazy_schemas or [],
+        "key_capacities": list(key_capacities or []),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
     name = f"ckpt-{batches:010d}.npz"
@@ -307,4 +326,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         job_name=meta.get("job_name"),
         parallelism=meta.get("parallelism", 1),
         lazy_schemas=meta.get("lazy_schemas", []),
+        key_capacities=meta.get("key_capacities", []),
     )

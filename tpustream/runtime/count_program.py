@@ -84,8 +84,12 @@ class CountWindowProgram(WindowProgram):
         }
 
     # per-key [K] leaves shard on the key axis, scalars replicate — the
-    # same rule the rolling per-key state uses
+    # same rule the rolling per-key state uses; likewise rescale/grow
+    # with the leading-key restack, NOT WindowProgram's flat word-plane
+    # layout (count state never uses the pane ring)
     state_specs = RollingProgram.state_specs
+    rescale_key_leaf = BaseProgram.rescale_key_leaf
+    grow_key_leaf = BaseProgram.grow_key_leaf
 
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)

@@ -414,14 +414,118 @@ class Runner:
             }
 
     def _check_capacity(self):
+        """Keyed state grows without bound, Flink's contract
+        (chapter2/README.md:8-10): when the distinct-key count passes
+        the current capacity, rebuild the program at 2x and migrate the
+        state — amortized one recompile per doubling. Runs before the
+        batch whose new keys would overflow ever reaches the device, so
+        no record is lost. The intern table is replay-deterministic, so
+        multi-host processes take the (collective) growth path at the
+        same feed."""
         if self.plan.key_pos is None:
             return
         table = self.program.pre_chain.out_tables[self.plan.key_pos]
-        if table is not None and len(table) > self.cfg.key_capacity:
-            raise RuntimeError(
-                f"distinct keys ({len(table)}) exceed StreamConfig.key_capacity "
-                f"({self.cfg.key_capacity}); raise key_capacity"
+        if table is None:
+            return
+        if len(table) > self.cfg.key_capacity:
+            # one rebuild straight to the needed power-of-two multiple,
+            # not one per doubling: a batch can intern many new keys
+            cap = self.cfg.key_capacity
+            while cap < len(table):
+                cap *= 2
+            self._grow_key_capacity(cap)
+
+    def _grow_key_capacity(self, new_capacity: Optional[int] = None):
+        """Rebuild the program at ``new_capacity`` (default 2x) and
+        migrate device state: key-sharded leaves block-copy into the
+        head of each shard's larger region (interned ids are stable and
+        the shard count is unchanged, so every key keeps its shard and
+        local row); replicated leaves (ring metadata, watermarks,
+        counters) carry over as-is."""
+        import dataclasses
+
+        from jax.sharding import NamedSharding, PartitionSpec, PartitionSpec as P
+
+        from ..parallel.mesh import AXIS
+
+        # in-flight emissions were computed against the old program and
+        # state (host-evaluated fires read self.state) — settle them
+        self.drain_inflight()
+        new_cap = new_capacity or self.cfg.key_capacity * 2
+        old_prog = self.program
+        # key-sharded leaves fetch LOCAL shards only (the migration is
+        # shard-local: every key keeps its shard and local row, so no
+        # cross-host traffic is needed); replicated leaves fetch once
+        old_leaves = [
+            self._fetch_local(l) if self._multiproc else np.asarray(
+                jax.device_get(l)
             )
+            for l in jax.tree_util.tree_leaves(self.state)
+        ]
+        self.cfg = dataclasses.replace(self.cfg, key_capacity=new_cap)
+        self.program = build_program(self.plan, self.cfg)
+        # trace-time flags the chain builder installed on the old
+        # program would be silently dropped by the rebuild (KeyError
+        # 'ts' / scrambled multi-host hand-off order)
+        for flag in ("emit_ts", "emit_chain_key"):
+            if getattr(old_prog, flag, False):
+                setattr(self.program, flag, True)
+        self._inner_step = self.program.jitted_step()
+        self.step = None
+        self._empty_cache = None
+        target = self.program.init_state()
+        t_leaves, treedef = jax.tree_util.tree_flatten(target)
+        spec_leaves = jax.tree_util.tree_leaves(
+            self.program.state_specs(target),
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        mesh = getattr(self.program, "mesh", None)
+        nproc = jax.process_count()
+        local_shards = (
+            self.program.n_shards // nproc if self._multiproc else None
+        )
+        migrated = []
+        for old, like, spec in zip(old_leaves, t_leaves, spec_leaves):
+            key_sharded = len(spec) and spec[0] == AXIS
+            if key_sharded:
+                init_host = np.asarray(jax.device_get(like))
+                if self._multiproc:
+                    rows = init_host.shape[0] // nproc
+                    pi = jax.process_index()
+                    leaf = self.program.grow_key_leaf(
+                        old, init_host[pi * rows : (pi + 1) * rows],
+                        shards=local_shards,
+                    )
+                else:
+                    leaf = self.program.grow_key_leaf(old, init_host)
+            else:
+                leaf = old
+            if mesh is None:
+                migrated.append(leaf)
+            elif self._multiproc and key_sharded:
+                migrated.append(
+                    jax.make_array_from_process_local_data(
+                        NamedSharding(mesh, spec), leaf, like.shape
+                    )
+                )
+            elif self._multiproc:
+                migrated.append(
+                    jax.make_array_from_callback(
+                        leaf.shape,
+                        NamedSharding(mesh, spec),
+                        lambda idx, a=leaf: a[idx],
+                    )
+                )
+            else:
+                migrated.append(
+                    jax.device_put(leaf, NamedSharding(mesh, spec))
+                )
+        self.state = jax.tree_util.tree_unflatten(treedef, migrated)
+        if self._multiproc:
+            # the rebuilt program needs the same multi-host hooks the
+            # constructor installed on the original
+            self.program._host_fetch = self._fetch_local
+            self._data_sharding = NamedSharding(mesh, P(AXIS))
 
     def _device_inputs(self, batch: Batch, domain: TimeCharacteristic):
         cols = [np.asarray(c.data) for c in batch.columns]
@@ -1268,6 +1372,14 @@ def execute_job(env, sink_nodes) -> JobResult:
             plans, cfg, metrics, lazy_schemas=ck.lazy_schemas
         )
         stages = runner.chain()
+        # dynamic key growth may have left a stage running above its
+        # configured capacity at snapshot time — rebuild UP to match.
+        # (A capacity configured above the snapshot's wins: restore
+        # grows the saved rows instead, never shrinking a user's
+        # headroom into repeated re-growth.)
+        for r, cap in zip(stages, ck.key_capacities or []):
+            if cap and cap > r.cfg.key_capacity:
+                r._grow_key_capacity(cap)
         states = ck.restore_chain([r.program for r in stages])
         for r, s in zip(stages, states):
             r.state = s
@@ -1401,6 +1513,7 @@ def execute_job(env, sink_nodes) -> JobResult:
             save_checkpoint(
                 cfg.checkpoint_dir,
                 lazy_schemas=lazy_schemas,
+                key_capacities=[r.cfg.key_capacity for r in stages],
                 state=(
                     [r.state for r in stages]
                     if len(stages) > 1

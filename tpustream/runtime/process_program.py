@@ -107,9 +107,10 @@ class ProcessWindowProgram(WindowProgram):
         # (WindowProgram's override is for its flat word-plane layout)
         return BaseProgram.state_specs(self, state)
 
-    # leading-key leaves rescale with the base restack, not the flat
-    # word-plane one
+    # leading-key leaves rescale/grow with the base restack, not the
+    # flat word-plane one
     rescale_key_leaf = BaseProgram.rescale_key_leaf
+    grow_key_leaf = BaseProgram.grow_key_leaf
 
     def _append_elements(self, buf, cnt, keys, mid_cols, live, pane):
         """Append the batch's live records to their (key, slot) element

@@ -112,9 +112,10 @@ class SessionWindowProgram(WindowProgram):
         # word-plane layout of WindowProgram
         return BaseProgram.state_specs(self, state)
 
-    # leading-key leaves rescale with the base restack, not the flat
-    # word-plane one
+    # leading-key leaves rescale/grow with the base restack, not the
+    # flat word-plane one
     rescale_key_leaf = BaseProgram.rescale_key_leaf
+    grow_key_leaf = BaseProgram.grow_key_leaf
 
     # ------------------------------------------------------------------
     def _scatter_session(self, state, keys, mid_cols, live, pane, ts):

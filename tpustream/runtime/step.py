@@ -99,6 +99,28 @@ class BaseProgram:
             ).reshape(arr.shape)
         )
 
+    def grow_key_leaf(
+        self, old: np.ndarray, new_init: np.ndarray, shards: int = None
+    ) -> np.ndarray:
+        """Migrate a key-sharded leaf into THIS (larger-capacity)
+        program's layout (dynamic key-capacity growth). The shard count
+        is unchanged and interned key ids are stable, so key ``g`` stays
+        on shard ``g % S`` at the same local row — each shard's old rows
+        copy into the head of its new block; new rows keep the fresh
+        init values (identities / unseen sentinels). ``shards``
+        overrides the shard count for PROCESS-LOCAL migration (the
+        arrays then cover only this process's contiguous shard blocks —
+        the copy is shard-local either way)."""
+        S = shards or max(1, self.n_shards)
+        k_lo = old.shape[0] // S
+        out = np.array(new_init)
+        k_ln = out.shape[0] // S
+        k = min(k_lo, k_ln)  # k_ln < k_lo only when re-laying fresh state
+        out.reshape(S, k_ln, *old.shape[1:])[:, :k] = old.reshape(
+            S, k_lo, *old.shape[1:]
+        )[:, :k]
+        return out
+
     # False for programs with no time semantics (per-record rolling,
     # count windows, stateless chains): a clock tick / EOS flush step can
     # never produce output for them, so the executor skips it

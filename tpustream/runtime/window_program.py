@@ -358,6 +358,21 @@ class WindowProgram(BaseProgram):
             canon.reshape(n, K // S_n, S_n).transpose(2, 0, 1).reshape(-1)
         )
 
+    def grow_key_leaf(self, old, new_init, shards: int = None):
+        """Key-capacity growth for the FLAT word planes: per shard, each
+        slot's old local-key run copies into the head of the slot's new
+        (longer) run. ``shards`` overrides for process-local migration."""
+        import numpy as np
+
+        S = shards or max(1, self.n_shards)
+        n = self.ring.n_slots
+        k_lo = old.shape[0] // (S * n)
+        out = np.array(new_init)
+        k_ln = out.shape[0] // (S * n)
+        k = min(k_lo, k_ln)
+        out.reshape(S, n, k_ln)[:, :, :k] = old.reshape(S, n, k_lo)[:, :, :k]
+        return out
+
     # ------------------------------------------------------------------
     def init_state(self):
         # planes live FLAT (cell = slot * keys + key): reshape wrappers
