@@ -372,3 +372,43 @@ def test_chain_after_process_late_str_after_float_fails_loudly():
     env = _late_emission_env(lambda n: 1.5 if n == 1 else "oops")
     with pytest.raises(ValueError, match="non-numeric"):
         env.execute("late-str-after-float")
+
+
+def test_chain_equal_ts_fires_split_across_subbatches_not_late():
+    """Regression: stage-1 windows fire many same-timestamp results in
+    one pump; when they split across stage-2 sub-batches (batch_size
+    smaller than the fire count), the data-driven watermark must not
+    fire the stage-2 window between sub-batches and drop the tail as
+    late. Chained window-fed stages use watermark delay 1 (a result at
+    ts T cannot close a window ending T+1), matching Flink's
+    records-before-watermark ordering."""
+    from tpustream import Tuple2
+
+    add = lambda a, b: Tuple2(a.f0, a.f1 + b.f1)
+
+    def run(bs):
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=bs, key_capacity=16)
+        )
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        lines = [
+            f"{1000 + i * 900} k{i % 7} {i + 1}" for i in range(24)
+        ] + ["60000 kx 100"]
+        text = env.add_source(ReplaySource(lines))
+        h = (
+            text.assign_timestamps_and_watermarks(Ts())
+            .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .time_window(Time.seconds(4))
+            .reduce(add)
+            .key_by(0)
+            .time_window(Time.seconds(12))
+            .reduce(add)
+            .collect()
+        )
+        env.execute("subbatch-split")
+        assert env.metrics.late_dropped == 0, bs
+        return sorted(repr(t) for t in h.items)
+
+    # bs=4: the five same-ts [8s,12s) fires split 4+1 downstream
+    assert run(4) == run(32)

@@ -115,6 +115,85 @@ def test_execution_strategies_are_observationally_identical(seed):
     assert got == base16, "raw-bytes lane diverged"
 
 
+# ---------------------------------------------------------------------------
+# chained (two-stage) jobs under the same fuzz (VERDICT r3 weak #3):
+# the re-key hand-off — columnar chain glue, canonical cross-shard
+# ordering, ts forwarding — is itself a pure execution mechanism and
+# must be configuration-invariant too.
+# ---------------------------------------------------------------------------
+
+def build_chained_window_window(env, text):
+    add = lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2)
+    return (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(1)
+        .time_window(Time.seconds(10), Time.seconds(2))
+        .reduce(add)
+        .key_by(1)
+        .time_window(Time.seconds(20))
+        .reduce(add)
+    )
+
+
+def build_chained_rolling_window(env, text):
+    add = lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2)
+    return (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(1)
+        .max(2)
+        .key_by(1)
+        .time_window(Time.seconds(8))
+        .reduce(add)
+    )
+
+
+CHAIN_BUILDERS = {
+    "window_window": build_chained_window_window,
+    "rolling_window": build_chained_rolling_window,
+}
+
+
+def _run_chained(builder, lines, source_kind="lines", **cfg):
+    cfg.setdefault("batch_size", 16)
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    if source_kind == "raw":
+        bs = cfg["batch_size"]
+        buffers = [
+            ("\n".join(lines[i : i + bs]).encode(), len(lines[i : i + bs]))
+            for i in range(0, len(lines), bs)
+        ]
+        src = ReplayBytesSource(buffers)
+    else:
+        src = ReplaySource(lines)
+    handle = CHAIN_BUILDERS[builder](env, env.add_source(src)).collect()
+    env.execute("equiv-chained")
+    return collections.Counter(tuple(t) for t in handle.items)
+
+
+@pytest.mark.parametrize(
+    "seed,builder", [(11, "window_window"), (12, "rolling_window")]
+)
+def test_chained_execution_strategies_identical(seed, builder):
+    lines = _stream(seed, n=250)
+    base = _run_chained(builder, lines)
+    assert sum(base.values()) > 10, "chain produced too little output"
+    variants = {
+        "parallel4": dict(parallelism=4, key_capacity=64),
+        "deep_pipeline": dict(async_depth=8),
+        "no_compress": dict(h2d_compress=False),
+    }
+    for name, cfg in variants.items():
+        got = _run_chained(builder, lines, **cfg)
+        assert got == base, (
+            f"{builder}/{name} diverged from the reference run (seed {seed})"
+        )
+    got = _run_chained(builder, lines, source_kind="raw")
+    assert got == base, f"{builder}/raw lane diverged (seed {seed})"
+
+
 def test_batch_size_invariant_without_lateness(seed=3):
     """With no late records, batch size only changes WHEN the watermark
     advances, never what fires: outputs must be exactly equal. (With

@@ -1,8 +1,10 @@
 """keyBy(KeySelector): Flink's surface accepts a key function, not just
 a field index (VERDICT r2 missing #5). Field-projecting selectors — the
 practical usage — resolve to field indices at plan time via a sentinel
-probe (runtime/plan.py resolve_key_selector); derived-key selectors are
-rejected with a remediation message.
+probe (runtime/plan.py resolve_key_selector); selectors COMPUTING a
+derived key (VERDICT r3 next #6) fall back to host evaluation per
+record, interned into a synthetic key column that user functions and
+emissions never see.
 """
 
 import pytest
@@ -21,9 +23,13 @@ def parse(line):
 LINES = ["a 1", "b 10", "a 2", "b 20", "a 4"]
 
 
-def run(key):
-    env = StreamExecutionEnvironment(StreamConfig(batch_size=2, key_capacity=16))
-    text = env.add_source(ReplaySource(LINES))
+def run(key, parallelism=0, lines=LINES, **cfg):
+    cfg.setdefault("batch_size", 2)
+    cfg.setdefault("key_capacity", 16)
+    if parallelism:
+        cfg.update(parallelism=parallelism, print_parallelism=1)
+    env = StreamExecutionEnvironment(StreamConfig(**cfg))
+    text = env.add_source(ReplaySource(lines))
     h = (
         text.map(parse)
         .key_by(key)
@@ -65,8 +71,10 @@ def test_resolver_units():
     assert resolve_key_selector(lambda r: r[3]) == 3
 
 
-def test_derived_key_selector_rejected_clearly():
-    with pytest.raises(NotImplementedError, match="derived"):
+def test_resolver_rejects_computed_selector():
+    # the RESOLVER still refuses (no field to project); the planner
+    # catches this and routes to the host-evaluated fallback
+    with pytest.raises(NotImplementedError, match="computed"):
         resolve_key_selector(lambda r: str(r.f0) + "x")
 
 
@@ -74,3 +82,153 @@ def test_bool_key_rejected():
     # bool subclasses int: key_by(True) must not silently key on field 1
     with pytest.raises(NotImplementedError):
         resolve_key_selector(True)
+
+
+# ---------------------------------------------------------------------------
+# computed (derived-key) selectors: host-evaluated fallback
+# ---------------------------------------------------------------------------
+
+def test_computed_selector_matches_projection_groups():
+    # str(r.f0) + "x" derives a key BIJECTIVE with f0: groups (and the
+    # visible output records) must match keying on the field itself
+    assert run(lambda r: str(r.f0) + "x") == run(0)
+
+
+def test_computed_selector_coarser_groups():
+    lines = ["a 1", "b 10", "c 100", "aa 2", "bb 20", "cc 200"]
+    got = run(lambda r: len(r.f0), lines=lines)
+    # keys: 1 -> a,b,c ; 2 -> aa,bb,cc — rolling sums with Flink's
+    # stale-field record semantics (first record's f0 is kept)
+    assert got == [
+        ("a", 1.0), ("a", 11.0), ("a", 111.0),
+        ("aa", 2.0), ("aa", 22.0), ("aa", 222.0),
+    ]
+
+
+def test_computed_selector_sharded():
+    lines = [f"h{i % 5} {i}" for i in range(24)]
+    single = run(lambda r: len(r.f0) + hash(r.f0) % 7, lines=lines,
+                 batch_size=8)
+    sharded = run(lambda r: len(r.f0) + hash(r.f0) % 7, lines=lines,
+                  parallelism=4, batch_size=8, key_capacity=64)
+    assert sorted(single) == sorted(sharded)
+
+
+def test_computed_selector_process_window_gets_original_key():
+    """The user process fn must receive the TRUE derived key (here an
+    int), not a stringified form, and elements without any synthetic
+    field."""
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        Time,
+        TimeCharacteristic,
+    )
+
+    class Ts(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(1000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    seen = []
+
+    def probe(key, ctx, elements, out):
+        seen.append((key, [tuple(e) if hasattr(e, "f0") else e for e in elements]))
+        out.collect(Tuple2(str(key), float(len(list(elements)))))
+
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=2, key_capacity=16))
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    lines = ["1000 a 1", "2000 bb 2", "3000 c 3", "12000 dd 4"]
+    text = env.add_source(ReplaySource(lines))
+    h = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+        .key_by(lambda r: len(r.f0))        # derived int key: 1 or 2
+        .time_window(Time.seconds(10))
+        .process(probe)
+        .collect()
+    )
+    env.execute("computed-process")
+    # fires: key 1 = [0,10s) (a, c); key 2 = [0,10s) (bb) + [10,20s) (dd)
+    keys = sorted(k for k, _ in seen)
+    assert keys == [1, 2, 2], keys
+    assert all(isinstance(k, int) for k, _ in seen)
+    # elements are the visible 2-field records
+    assert all(len(e) == 2 for _, els in seen for e in els)
+
+
+def test_later_key_by_supersedes_computed_key():
+    """key_by(computed).key_by(0): the LAST key_by wins (Flink
+    semantics) — the superseded synthetic column must be dropped, not
+    silently kept as the grouping key."""
+    assert run(0) == [
+        ("a", 1.0), ("b", 10.0), ("a", 3.0), ("b", 30.0), ("a", 7.0),
+    ]
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    text = env.add_source(ReplaySource(LINES))
+    h = (
+        text.map(parse)
+        .key_by(lambda r: 1)          # constant computed key...
+        .key_by(0)                    # ...superseded by field 0
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    env.execute("superseded")
+    assert [(t.f0, t.f1) for t in h.items] == run(0)
+
+
+def test_computed_selector_checkpoint_resume(tmp_path):
+    """Computed-key jobs checkpoint/resume: the restored adaptive
+    schema's trailing synthetic column must come back as a
+    DerivedKeyTable (intern_values + original-value lookup)."""
+    import glob
+    import os
+
+    from tpustream.runtime.checkpoint import load_checkpoint
+
+    lines = [f"h{i % 5}{'x' * (i % 3)} {i + 1}" for i in range(12)]
+
+    def job(ckdir=None, restore=None):
+        cfg = dict(batch_size=2, key_capacity=16)
+        if ckdir:
+            cfg.update(checkpoint_dir=ckdir, checkpoint_interval_batches=1)
+        env = StreamExecutionEnvironment(StreamConfig(**cfg))
+        if restore:
+            env.restore_from_checkpoint(restore)
+        text = env.add_source(ReplaySource(lines))
+        h = (
+            text.map(parse)
+            .key_by(lambda r: len(r.f0))
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+            .collect()
+        )
+        env.execute("computed-ckpt")
+        return [(t.f0, t.f1) for t in h.items]
+
+    full = job()
+    ckdir = str(tmp_path / "ck")
+    assert job(ckdir=ckdir) == full
+    snaps = sorted(glob.glob(os.path.join(ckdir, "ckpt-*.npz")))
+    assert snaps
+    for snap in snaps:
+        ck = load_checkpoint(snap)
+        assert job(restore=snap) == full[ck.emitted :]
+
+
+def test_computed_selector_rejected_on_chain_stage():
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=2, key_capacity=16))
+    text = env.add_source(ReplaySource(LINES))
+    (
+        text.map(parse)
+        .key_by(0)
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .key_by(lambda r: str(r.f0) + "x")
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    with pytest.raises(NotImplementedError, match="SOURCE stage"):
+        env.execute("chained-computed")

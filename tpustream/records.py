@@ -70,6 +70,52 @@ class StringTable:
         self._to_id = {s: i for i, s in enumerate(self._to_str)}
 
 
+class DerivedKeyTable(StringTable):
+    """Intern table for COMPUTED KeySelector results (a selector that
+    derives a key rather than projecting a field). Values intern under
+    a type-tagged canonical string (so ``True``/``1``/``"1"`` stay
+    distinct keys, as under Java hashCode/equals), while ``lookup``
+    returns the ORIGINAL value — user window/process functions receive
+    the true derived key, never a stringified form. JSON-serializable
+    for checkpoints (derived keys must be str/int/float/bool, the
+    sensible hashable surface)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._originals: List = []
+
+    def intern_value(self, v) -> int:
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        elif isinstance(v, np.floating):
+            v = float(v)
+        if not isinstance(v, (str, int, float, bool)):
+            raise TypeError(
+                f"a computed KeySelector must return str/int/float/bool, "
+                f"got {type(v).__name__}: {v!r}"
+            )
+        i = self.intern(f"{type(v).__name__}:{v!r}")
+        if i == len(self._originals):
+            self._originals.append(v)
+        return i
+
+    def intern_values(self, values) -> np.ndarray:
+        out = np.empty(len(values), dtype=np.int32)
+        for j, v in enumerate(values):
+            out[j] = self.intern_value(v)
+        return out
+
+    def lookup(self, i: int):
+        return self._originals[i]
+
+    def state_dict(self) -> dict:
+        return {"strings": list(self._to_str), "originals": list(self._originals)}
+
+    def load_state_dict(self, state: dict) -> None:
+        super().load_state_dict(state)
+        self._originals = list(state.get("originals", []))
+
+
 @dataclass
 class Column:
     """One field column: numpy data plus logical kind."""

@@ -201,12 +201,24 @@ class Checkpoint:
     def restore_tables(self, plan) -> None:
         """Restore string-intern tables (and record kinds for adaptive
         parse plans) so interned key ids keep their dense-slot meaning."""
-        from ..records import STR, StringTable
+        from ..records import STR, DerivedKeyTable, StringTable
 
         if not plan.record_kinds:
             plan.record_kinds.extend(self.record_kinds)
+            last = len(self.record_kinds) - 1
             plan.tables.extend(
-                StringTable() if k == STR else None for k in self.record_kinds
+                # a computed-KeySelector plan's trailing synthetic
+                # column must come back as a DerivedKeyTable (its
+                # lookup returns original values, and the host re-runs
+                # intern_values on it)
+                (
+                    DerivedKeyTable()
+                    if plan.synthetic_key and i == last
+                    else StringTable()
+                )
+                if k == STR
+                else None
+                for i, k in enumerate(self.record_kinds)
             )
         elif list(plan.record_kinds) != list(self.record_kinds):
             raise ValueError(

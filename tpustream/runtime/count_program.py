@@ -94,7 +94,8 @@ class CountWindowProgram(WindowProgram):
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
         K = state["cnt"].shape[0]
         N = self.count_n
 
@@ -280,7 +281,8 @@ class SlidingCountWindowProgram(_ElementLogMixin, CountWindowProgram):
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
         N, S = self.count_n, self.count_slide
 
         sb = self._sorted_batch(state, keys, mask)
@@ -403,7 +405,8 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
 
         mid_cols, mask = self.pre_chain.apply(cols, valid)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
         N, S = self.count_n, self.count_slide
 
         sb = self._sorted_batch(state, keys, mask)
@@ -472,7 +475,7 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
         key = np.asarray(fire_info["key"]).reshape(-1)
         arr = np.asarray(fire_info["arr"]).reshape(-1)
         kinds, tables = self.mid_kinds, self.mid_tables
-        key_table = tables[self.key_pos]
+        key_table = self._key_table()
 
         rows = np.nonzero(valid)[0]
         rows = rows[np.argsort(arr[rows], kind="stable")]

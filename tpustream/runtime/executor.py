@@ -55,6 +55,10 @@ class HostStage:
         objects. None when the job's host stage can't take it (fallback
         map, raw-stage filter/flat_map, punctuated watermarks)."""
         plan = self.plan
+        if plan.synthetic_key:
+            # the derived-key column is an arbitrary Python callable —
+            # no native lane
+            return None
         if len(plan.host_ops) != 1:
             return None
         hop = plan.host_ops[0]
@@ -101,6 +105,45 @@ class HostStage:
             for k, c, t in zip(plan.record_kinds, cols, plan.tables)
         ]
         return Batch(n, columns, ts=ts, proc_ts=proc_ts), None
+
+    @staticmethod
+    def _append_synthetic_schema(plan) -> None:
+        """Adaptive parse schemas resolve on the first batch; the
+        computed-KeySelector column appends right after (plan-time
+        resolution appends it in build_plan instead)."""
+        from ..records import DerivedKeyTable
+
+        if plan.synthetic_key:
+            plan.record_kinds.append(STR)
+            plan.tables.append(DerivedKeyTable())
+
+    def _derived_key_col(self, cols, n: int) -> np.ndarray:
+        """Computed-KeySelector fallback: reconstruct each visible
+        record from the parsed columns, run the user selector, intern
+        the result (per-record Python — the correctness lane; field
+        projections take the symbolic path and never come here)."""
+        from ..api.tuples import make_tuple
+
+        plan = self.plan
+        kinds = plan.record_kinds[:-1]
+        tables = plan.tables[:-1]
+        fn = plan.derived_key_fn  # already resolved to a callable
+        vals = []
+        for j in range(n):
+            fields = []
+            for k, t, c in zip(kinds, tables, cols):
+                v = c[j]
+                if k == STR:
+                    fields.append(t.lookup(int(v)))
+                elif k == "f64":
+                    fields.append(float(v))
+                elif k == "bool":
+                    fields.append(bool(v))
+                else:
+                    fields.append(int(v))
+            rec = fields[0] if len(fields) == 1 else make_tuple(*fields)
+            vals.append(fn(rec))
+        return plan.tables[-1].intern_values(vals)
 
     def _timestamps(self, lines: List[str]) -> Optional[np.ndarray]:
         plan = self.plan
@@ -175,6 +218,7 @@ class HostStage:
                 cols, kinds = run_fallback_map(fb, lines, plan.tables)
                 if not plan.record_kinds:
                     plan.record_kinds.extend(kinds)
+                    self._append_synthetic_schema(plan)
             break  # planner guarantees ops after the parse map are device-side
 
         if cols is None:
@@ -182,7 +226,11 @@ class HostStage:
             if not plan.record_kinds:
                 plan.record_kinds.append(STR)
                 plan.tables.append(StringTable())
+                self._append_synthetic_schema(plan)
             cols = [plan.tables[0].intern_many(lines)]
+
+        if plan.synthetic_key:
+            cols = list(cols) + [self._derived_key_col(cols, len(lines))]
 
         columns = [
             Column(k, c, t)

@@ -21,7 +21,7 @@ from ..api.watermarks import (
     BoundedOutOfOrdernessTimestampExtractor,
 )
 from ..api.windows import WindowSpec
-from ..records import STR, StringTable
+from ..records import STR, DerivedKeyTable, StringTable
 from .. import hostparse
 
 
@@ -58,26 +58,14 @@ class _RecordProbe:
         return _FieldProbe(int(i))
 
 
-def resolve_key_selector(key: Any) -> int:
-    """Turn a ``keyBy`` argument into a tuple field index.
+def selector_callable(key: Any):
+    """The callable behind a ``keyBy`` selector argument, or None.
 
-    Flink's surface accepts a field index or a ``KeySelector``; every
-    reference job uses indices (chapter2/.../ComputeCpuMax.java:26), and
-    in practice selectors project a field (``r -> r.f1``). The TPU
-    runtime keys on dense interned column ids, so a selector is resolved
-    AT PLAN TIME by probing it with a sentinel record: if it returns one
-    field unchanged, that field's index is the key. Selectors that
-    COMPUTE a derived key would need a device-traced key column and are
-    rejected with a clear error.
-    """
-    # bool is an int subclass: key_by(True) would silently key on field
-    # 1 — reject it as a non-selector instead
-    if isinstance(key, int) and not isinstance(key, bool):
-        return key
-    # probe every plausible entry point: a KeySelector subclass may
-    # override either get_key or the Flink-style getKey alias (the
-    # un-overridden one still resolves to the abstract base method and
-    # raises — skip it, don't give up)
+    A KeySelector subclass may override either ``get_key`` or the
+    Flink-style ``getKey`` alias; a bare lambda is the callable itself.
+    Probes each candidate with a sentinel record and prefers one that
+    runs (projecting probes return a field sentinel; computed selectors
+    raise on the sentinel but are still valid host-side callables)."""
     candidates = [
         getattr(key, meth)
         for meth in ("get_key", "getKey")
@@ -87,16 +75,50 @@ def resolve_key_selector(key: Any) -> int:
         candidates.append(key)
     for fn in candidates:
         try:
-            out = fn(_RecordProbe())
-        except Exception:
+            fn(_RecordProbe())
+            return fn
+        except NotImplementedError:
+            # the un-overridden abstract base method — try the next
             continue
-        if isinstance(out, _FieldProbe):
-            return out.index
+        except Exception:
+            # ran but choked on the probe (computed selector): usable
+            # as a per-record host callable
+            return fn
+    return None
+
+
+def resolve_key_selector(key: Any) -> int:
+    """Turn a ``keyBy`` argument into a tuple field index.
+
+    Flink's surface accepts a field index or a ``KeySelector``; every
+    reference job uses indices (chapter2/.../ComputeCpuMax.java:26), and
+    in practice selectors project a field (``r -> r.f1``). The TPU
+    runtime keys on dense interned column ids, so a selector is resolved
+    AT PLAN TIME by probing it with a sentinel record: if it returns one
+    field unchanged, that field's index is the key. Selectors that
+    COMPUTE a derived key raise here; build_plan catches that and falls
+    back to a host-evaluated synthetic key column (plan.synthetic_key).
+    """
+    # bool is an int subclass: key_by(True) would silently key on field
+    # 1 — reject it as a non-selector instead
+    if isinstance(key, int) and not isinstance(key, bool):
+        return key
+    fn = selector_callable(key)
+    if fn is None:
+        raise NotImplementedError(
+            f"key_by takes a tuple field index or a KeySelector "
+            f"(a callable / get_key | getKey overrider); got "
+            f"{type(key).__name__}: {key!r}"
+        )
+    try:
+        out = fn(_RecordProbe())
+    except Exception:
+        out = None
+    if isinstance(out, _FieldProbe):
+        return out.index
     raise NotImplementedError(
-        "key_by takes a tuple field index or a KeySelector that projects "
-        "one record field (e.g. lambda r: r.f1); selectors computing "
-        "derived keys are not supported — add the derived field with a "
-        "map() and key on it"
+        "this KeySelector does not project a single record field, so "
+        "it must run as a computed (host-evaluated) key"
     )
 
 
@@ -159,6 +181,13 @@ class JobPlan:
     # rolling aggregates forward the record's own timestamp), so
     # event-time windows need no assigner here
     upstream_supplies_ts: bool = False
+    # computed KeySelector fallback: the host evaluates derived_key_fn
+    # per record and interns the result into a SYNTHETIC trailing key
+    # column (record_kinds[-1], a DerivedKeyTable). The column exists
+    # only up to key extraction — user functions, stored state, and
+    # emissions all see the visible record without it.
+    synthetic_key: bool = False
+    derived_key_fn: Optional[Any] = None
 
 
 def _is_raw_stage(kinds: Optional[List[str]]) -> bool:
@@ -239,6 +268,8 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
     stateful: Optional[StatefulSpec] = None
     pending_window: Optional[Node] = None
     chain_rest: List[Node] = []
+    synthetic_key = False
+    derived_key_fn = None
 
     for node in nodes[1:]:
         op = node.op
@@ -288,7 +319,41 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
                 # next stage, fed by this stage's emissions
                 chain_rest = nodes[nodes.index(node):]
                 break
-            key_pos = resolve_key_selector(node.params["key"])
+            if synthetic_key:
+                # a later key_by SUPERSEDES a computed key: drop its
+                # synthetic column (else the runtime would silently
+                # keep keying on the stale derived key)
+                if record_kinds:
+                    record_kinds = record_kinds[:-1]
+                    tables = tables[:-1]
+                synthetic_key = False
+                derived_key_fn = None
+            try:
+                key_pos = resolve_key_selector(node.params["key"])
+            except NotImplementedError:
+                fn = selector_callable(node.params["key"])
+                if fn is None:
+                    raise
+                # computed KeySelector: host-evaluate per record into a
+                # synthetic trailing key column (the symbolic fast path
+                # stays for field projections). key_pos = -1 addresses
+                # the trailing column whatever the record arity —
+                # adaptive parse schemas append it on the first batch
+                # (HostStage), resolved ones here.
+                if any(o == "map" for o, _ in device_pre):
+                    raise NotImplementedError(
+                        "a computed KeySelector must follow the parse "
+                        "map directly (filters in between are fine); "
+                        "either move the map after the keyed operation "
+                        "or add the derived field in the map and key on "
+                        "it by index"
+                    )
+                derived_key_fn = fn
+                synthetic_key = True
+                if record_kinds:
+                    record_kinds = record_kinds + [STR]
+                    tables = tables + [DerivedKeyTable()]
+                key_pos = -1
             continue
         if op == "rolling":
             if key_pos is None:
@@ -366,6 +431,8 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
         side_outputs=side_outputs,
         time_characteristic=env.time_characteristic,
         chain_rest=chain_rest,
+        synthetic_key=synthetic_key,
+        derived_key_fn=derived_key_fn,
     )
 
 
@@ -381,6 +448,24 @@ def build_plan_chain(env, sink_nodes: List[Node]) -> List[JobPlan]:
         prev = plans[-1]
         plans.append(_plan_rest(env, prev.chain_rest))
         prev.chain_rest = []
+    # watermark delay for chained event-time stages. Flink forwards
+    # watermarks through operators, and a watermark arrives AFTER the
+    # records preceding it — so a downstream window must never fire off
+    # a record batch that is still being folded. Our chained stages
+    # derive their watermark from DATA (max_ts - delay); with delay 0 a
+    # window-fed stage would fire a window the instant a result at ts
+    # end-1 folds, racing equal-ts results split across sub-batches
+    # (observed drop: five same-ts fires split 4+1 over batch_size-4
+    # sub-batches — the fifth arrived "late"). delay 1 closes the race:
+    # a result at ts T cannot close a window ending T+1. Rolling stages
+    # forward the ORIGINAL record timestamp, so the source assigner's
+    # out-of-orderness bound still applies downstream.
+    for up, down in zip(plans, plans[1:]):
+        st = up.stateful
+        if st is not None and st.kind in ("rolling", "rolling_reduce"):
+            down.ts_delay_ms = max(1, up.ts_delay_ms)
+        else:
+            down.ts_delay_ms = 1
     if len(plans) > 1:
         # branches/sinks live on the LAST stage; intermediates feed the
         # chain glue in the executor. (Late side outputs stay on
@@ -419,7 +504,17 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
             if stateful is not None:
                 chain_rest = rest[i:]
                 break
-            key_pos = resolve_key_selector(node.params["key"])
+            try:
+                key_pos = resolve_key_selector(node.params["key"])
+            except NotImplementedError:
+                if selector_callable(node.params["key"]) is not None:
+                    raise NotImplementedError(
+                        "a computed KeySelector is supported on the "
+                        "SOURCE stage only; on a chained stage, emit "
+                        "the derived field from the upstream stage and "
+                        "key on it by index"
+                    )
+                raise
             continue
         if op == "rolling":
             if key_pos is None:

@@ -175,7 +175,8 @@ class ProcessWindowProgram(WindowProgram):
 
         # keyBy: route records to their key-owner shard (ICI all_to_all)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
         k = state["cnt"].shape[0]  # LOCAL key rows under shard_map
 
         late = pane_ops.late_mask(ts, wm_old, self.allowed_lateness_ms, ring) & mask
@@ -308,7 +309,7 @@ class ProcessWindowProgram(WindowProgram):
         bufs = [self._host_fetch(b) for b in state["buf"]]
         n, cap = ring.n_slots, self.cfg.process_buffer_capacity
         kinds, tables = self.mid_kinds, self.mid_tables
-        key_table = tables[self.key_pos]
+        key_table = self._key_table()
         k_local = self.local_key_capacity
         shard_base = self._host_shard_base()
         emitted = 0

@@ -270,7 +270,8 @@ class SessionWindowProgram(WindowProgram):
         )
 
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
 
         # Flink's merging-window lateness test: a record is late only if
         # its MERGED window would be late — solo window past the
@@ -469,7 +470,8 @@ class SessionProcessProgram(ProcessWindowProgram):
         )
 
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
         k = state["cnt"].shape[0]
 
         # ---- apply the PREVIOUS step's marks and clears ------------------
@@ -643,7 +645,7 @@ class SessionProcessProgram(ProcessWindowProgram):
         hi = int(self._host_fetch(state["hi"]))
         bufs = [self._host_fetch(b) for b in state["buf"]]
         kinds, tables = self.mid_kinds, self.mid_tables
-        key_table = tables[self.key_pos]
+        key_table = self._key_table()
         shard_base = self._host_shard_base()
 
         o = np.arange(n, dtype=np.int64)

@@ -47,8 +47,31 @@ class BaseProgram:
         )
         self.mid_kinds = self.pre_chain.out_kinds
         self.mid_tables = self.pre_chain.out_tables
+        if plan.synthetic_key:
+            # the host-computed derived-key column rides as the LAST
+            # input column up to key extraction only: the VISIBLE mid
+            # schema (user fns, stored state, emissions) excludes it
+            self.mid_kinds = self.mid_kinds[:-1]
+            self.mid_tables = self.mid_tables[:-1]
         # post chain input kinds are set by the subclass (stateful output)
         self.post_chain: Optional[DeviceChain] = None
+
+    def _split_key_col(self, mid_cols):
+        """(visible mid cols, raw key column). Call AFTER the exchange
+        (the synthetic column must ride the all_to_all with its
+        records); everything downstream of this sees only the visible
+        record."""
+        if self.plan.synthetic_key:
+            return list(mid_cols[:-1]), mid_cols[-1]
+        return list(mid_cols), mid_cols[self.key_pos]
+
+    def _key_table(self):
+        """Intern table for key ids (host fire evaluation). For a
+        computed KeySelector this is the DerivedKeyTable, whose lookup
+        returns the original derived value."""
+        if self.plan.synthetic_key:
+            return self.plan.tables[-1]
+        return self.mid_tables[self.key_pos]
 
     # subclasses: init_state(), _step(state, cols, valid, ts, wm_lower)
 
@@ -289,7 +312,7 @@ class RollingProgram(BaseProgram):
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self.pre_chain.apply(cols, valid)
         mid_cols, mask, ts, _ = self._exchange(mid_cols, mask, ts)
-        gkeys = mid_cols[self.key_pos]
+        mid_cols, gkeys = self._split_key_col(mid_cols)
         keys = self._local_keys(gkeys)
         st = self.plan.stateful
         fast_kwargs = {}
@@ -298,7 +321,11 @@ class RollingProgram(BaseProgram):
                 rolling_kind=st.rolling_kind, rolling_pos=st.rolling_pos,
                 sentinel_leaf=self._sentinel_leaf,
             )
-            key_kind = self.mid_kinds[self.key_pos]
+            key_kind = (
+                None
+                if self.plan.synthetic_key  # key not in the visible record
+                else self.mid_kinds[self.key_pos]
+            )
             if self.key_pos != st.rolling_pos and key_kind in (STR, I64):
                 # key column is key-invariant: emit it straight from the
                 # sorted key ids and never touch its state plane

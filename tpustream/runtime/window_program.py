@@ -855,7 +855,8 @@ class WindowProgram(BaseProgram):
 
         # keyBy: route records to their key-owner shard (ICI all_to_all)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
-        keys = self._local_keys(mid_cols[self.key_pos])
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
 
         late = pane_ops.late_mask(ts, wm_old, self.allowed_lateness_ms, ring) & mask
         live = mask & ~late
