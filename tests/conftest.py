@@ -15,6 +15,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache (VERDICT r3 next #9): the suite is
+# compile-bound on this 1-core host — most tests build fresh jitted
+# programs whose XLA compiles repeat run to run. Caching them on disk
+# cuts the full gate roughly in half after the first (populating) run.
+# Env vars rather than jax.config so the 2-process jax.distributed
+# worker subprocesses inherit the same cache.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

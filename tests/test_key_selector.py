@@ -158,6 +158,26 @@ def test_computed_selector_process_window_gets_original_key():
     assert all(len(e) == 2 for _, els in seen for e in els)
 
 
+def test_computed_selector_with_pre_filter_scalar_records():
+    """A device filter between the parse map and a computed key_by must
+    see the bare visible record — never the synthetic key column
+    (regression: scalar-record filters crashed with Tuple2 vs int)."""
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    text = env.add_source(ReplaySource(["1", "2", "3", "4", "5"]))
+    h = (
+        text.map(lambda l: int(l))
+        .filter(lambda v: v > 1)
+        .key_by(lambda v: v % 2)
+        .reduce(lambda a, b: a + b)
+        .collect()
+    )
+    env.execute("filter-computed")
+    # rolling sums of 2,3,4,5 grouped by parity
+    assert h.items == [2, 3, 6, 8]
+
+
 def test_later_key_by_supersedes_computed_key():
     """key_by(computed).key_by(0): the LAST key_by wins (Flink
     semantics) — the superseded synthetic column must be dropped, not
@@ -219,16 +239,101 @@ def test_computed_selector_checkpoint_resume(tmp_path):
         assert job(restore=snap) == full[ck.emitted :]
 
 
-def test_computed_selector_rejected_on_chain_stage():
-    env = StreamExecutionEnvironment(StreamConfig(batch_size=2, key_capacity=16))
-    text = env.add_source(ReplaySource(LINES))
-    (
+def test_computed_selector_on_chain_stage():
+    """A computed KeySelector on a CHAIN stage: the glue derives the
+    key from each hand-off batch. Checked against a record-at-a-time
+    Python oracle of the two rolling stages."""
+    lines = ["a 1", "bb 10", "c 2", "dd 20", "e 4", "ff 40", "a 8"]
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    text = env.add_source(ReplaySource(lines))
+    h = (
         text.map(parse)
         .key_by(0)
         .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
-        .key_by(lambda r: str(r.f0) + "x")
+        .key_by(lambda r: len(r.f0))
         .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
         .collect()
     )
-    with pytest.raises(NotImplementedError, match="SOURCE stage"):
-        env.execute("chained-computed")
+    env.execute("chained-computed")
+
+    # oracle: stage 1 = per-name rolling sum (one emission per record);
+    # stage 2 = rolling sum grouped by len(name), Flink stale-field
+    # record semantics (first record's f0 kept per group)
+    s1_state, s1_out = {}, []
+    for ln in lines:
+        k, v = ln.split(" ")[0], float(ln.split(" ")[1])
+        s1_state[k] = s1_state.get(k, 0.0) + v
+        s1_out.append((k, s1_state[k]))
+    s2_state, expect = {}, []
+    for k, v in s1_out:
+        g = len(k)
+        if g in s2_state:
+            k0, v0 = s2_state[g]
+            s2_state[g] = (k0, v0 + v)
+        else:
+            s2_state[g] = (k, v)
+        expect.append(s2_state[g])
+    assert [(t.f0, t.f1) for t in h.items] == expect
+
+
+def test_computed_selector_on_chain_stage_checkpoint_resume(tmp_path):
+    """Chain-stage DerivedKeyTables are runtime-built: a resumed run
+    must reload their snapshot (chain_key_tables) so saved state rows
+    keep their key ids."""
+    import glob
+    import os
+
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        Time,
+        TimeCharacteristic,
+    )
+    from tpustream.runtime.checkpoint import load_checkpoint
+
+    class Ts(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(1000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    lines = [
+        f"{1000 + i * 900} {'k' * (i % 3 + 1)}{i % 4} {i + 1}"
+        for i in range(16)
+    ] + ["60000 z 100"]
+
+    def job(ckdir=None, restore=None):
+        cfg = dict(batch_size=4, key_capacity=16)
+        if ckdir:
+            cfg.update(checkpoint_dir=ckdir, checkpoint_interval_batches=1)
+        env = StreamExecutionEnvironment(StreamConfig(**cfg))
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        if restore:
+            env.restore_from_checkpoint(restore)
+        text = env.add_source(ReplaySource(lines))
+        h = (
+            text.assign_timestamps_and_watermarks(Ts())
+            .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .time_window(Time.seconds(5))
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+            .key_by(lambda r: len(r.f0))
+            .time_window(Time.seconds(15))
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+            .collect()
+        )
+        env.execute("chained-computed-ckpt")
+        return [(t.f0, t.f1) for t in h.items]
+
+    full = job()
+    assert full
+    ckdir = str(tmp_path / "ck")
+    assert job(ckdir=ckdir) == full
+    snaps = sorted(glob.glob(os.path.join(ckdir, "ckpt-*.npz")))
+    assert snaps
+    for snap in snaps:
+        ck = load_checkpoint(snap)
+        assert job(restore=snap) == full[ck.emitted :]

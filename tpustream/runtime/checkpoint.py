@@ -86,6 +86,11 @@ class Checkpoint:
     # and the restored runners must be rebuilt to match before their
     # state leaves place
     key_capacities: Optional[list] = None
+    # per built chain stage: the DerivedKeyTable state of a
+    # computed-KeySelector stage (None elsewhere). Chain-stage key
+    # tables are built at runtime, so without this a resumed run would
+    # re-intern only post-snapshot keys and mis-map saved state rows.
+    chain_key_tables: Optional[list] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -244,6 +249,7 @@ def save_checkpoint(
     keep: int = 3,
     lazy_schemas: Optional[list] = None,
     key_capacities: Optional[list] = None,
+    chain_key_tables: Optional[list] = None,
 ) -> str:
     """Snapshot to ``directory/ckpt-<batches>.npz`` (atomic rename); prunes
     to the ``keep`` newest snapshots and refreshes ``latest`` marker."""
@@ -262,6 +268,7 @@ def save_checkpoint(
         "parallelism": int(parallelism),
         "lazy_schemas": lazy_schemas or [],
         "key_capacities": list(key_capacities or []),
+        "chain_key_tables": list(chain_key_tables or []),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
     name = f"ckpt-{batches:010d}.npz"
@@ -339,4 +346,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         parallelism=meta.get("parallelism", 1),
         lazy_schemas=meta.get("lazy_schemas", []),
         key_capacities=meta.get("key_capacities", []),
+        chain_key_tables=meta.get("chain_key_tables", []),
     )

@@ -92,7 +92,7 @@ class CountWindowProgram(WindowProgram):
     grow_key_leaf = BaseProgram.grow_key_leaf
 
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
         mid_cols, key_col = self._split_key_col(mid_cols)
         keys = self._local_keys(key_col)
@@ -279,7 +279,7 @@ class SlidingCountWindowProgram(_ElementLogMixin, CountWindowProgram):
         }
 
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
         mid_cols, key_col = self._split_key_col(mid_cols)
         keys = self._local_keys(key_col)
@@ -403,7 +403,7 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
     def _step(self, state, cols, valid, ts, wm_lower):
         from ..ops import panes as pane_ops
 
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
         mid_cols, key_col = self._split_key_col(mid_cols)
         keys = self._local_keys(key_col)

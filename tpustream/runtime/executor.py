@@ -25,7 +25,7 @@ from ..api.watermarks import (
 )
 from ..config import StreamConfig
 from ..hostparse import PlanEvaluator, run_fallback_map
-from ..records import STR, Batch, Column, StringTable
+from ..records import STR, Batch, Column, DerivedKeyTable, StringTable
 from ..api.timeapi import TimeCharacteristic
 from .metrics import Metrics, Stopwatch
 from .plan import JobPlan, build_plan_chain
@@ -118,32 +118,7 @@ class HostStage:
             plan.tables.append(DerivedKeyTable())
 
     def _derived_key_col(self, cols, n: int) -> np.ndarray:
-        """Computed-KeySelector fallback: reconstruct each visible
-        record from the parsed columns, run the user selector, intern
-        the result (per-record Python — the correctness lane; field
-        projections take the symbolic path and never come here)."""
-        from ..api.tuples import make_tuple
-
-        plan = self.plan
-        kinds = plan.record_kinds[:-1]
-        tables = plan.tables[:-1]
-        fn = plan.derived_key_fn  # already resolved to a callable
-        vals = []
-        for j in range(n):
-            fields = []
-            for k, t, c in zip(kinds, tables, cols):
-                v = c[j]
-                if k == STR:
-                    fields.append(t.lookup(int(v)))
-                elif k == "f64":
-                    fields.append(float(v))
-                elif k == "bool":
-                    fields.append(bool(v))
-                else:
-                    fields.append(int(v))
-            rec = fields[0] if len(fields) == 1 else make_tuple(*fields)
-            vals.append(fn(rec))
-        return plan.tables[-1].intern_values(vals)
+        return derive_key_column(self.plan, cols, n)
 
     def _timestamps(self, lines: List[str]) -> Optional[np.ndarray]:
         plan = self.plan
@@ -268,6 +243,36 @@ def _allgather_rows(arrays: List[np.ndarray]) -> List[np.ndarray]:
             )
         )
     return out
+
+
+def derive_key_column(plan, cols, n: int) -> np.ndarray:
+    """Computed-KeySelector fallback: reconstruct each visible record
+    from its columns, run the user selector, intern the result into the
+    plan's trailing DerivedKeyTable (per-record Python — the
+    correctness lane; field projections take the symbolic path and
+    never come here). Used by the host parse stage and by the chain
+    glue when a CHAIN stage keys by a computed selector."""
+    from ..api.tuples import make_tuple
+
+    kinds = plan.record_kinds[:-1]
+    tables = plan.tables[:-1]
+    fn = plan.derived_key_fn  # already resolved to a callable
+    vals = []
+    for j in range(n):
+        fields = []
+        for k, t, c in zip(kinds, tables, cols):
+            v = c[j]
+            if k == STR:
+                fields.append(t.lookup(int(v)))
+            elif k == "f64":
+                fields.append(float(v))
+            elif k == "bool":
+                fields.append(bool(v))
+            else:
+                fields.append(int(v))
+        rec = fields[0] if len(fields) == 1 else make_tuple(*fields)
+        vals.append(fn(rec))
+    return plan.tables[-1].intern_values(vals)
 
 
 def _row_fields(row) -> list:
@@ -472,7 +477,12 @@ class Runner:
         same feed."""
         if self.plan.key_pos is None:
             return
-        table = self.program.pre_chain.out_tables[self.plan.key_pos]
+        if self.plan.synthetic_key:
+            # the derived-key table lives on the plan, outside the
+            # (visible-record) pre chain
+            table = self.plan.tables[-1] if self.plan.tables else None
+        else:
+            table = self.program.pre_chain.out_tables[self.plan.key_pos]
         if table is None:
             return
         if len(table) > self.cfg.key_capacity:
@@ -829,6 +839,9 @@ class Runner:
         p2 = self._lazy_plans[0]
         p2.record_kinds.extend(kinds)
         p2.tables.extend(StringTable() if k == STR else None for k in kinds)
+        if p2.synthetic_key:
+            p2.record_kinds.append(STR)
+            p2.tables.append(DerivedKeyTable())
         d = _make_runner_chain(self._lazy_plans, self.cfg, self.metrics)
         # the inferred schema is snapshotted with checkpoints so a
         # restored run can rebuild this runner without re-inference
@@ -850,6 +863,9 @@ class Runner:
         )
         d = self.downstream
         kinds, tables = d.plan.record_kinds, d.plan.tables
+        if d.plan.synthetic_key:
+            # visible columns only; pump_chain appends the derived key
+            kinds, tables = kinds[:-1], tables[:-1]
         fields = [_row_fields(r) for r in rows]
 
         def _bad(i, what, kind, hint=""):
@@ -1008,6 +1024,12 @@ class Runner:
             cols = []
         if cols and len(cols[0]):
             n = len(cols[0])
+            if d.plan.synthetic_key:
+                # computed KeySelector on the downstream stage: derive
+                # the key from the (identical-on-every-process) batch
+                cols = list(cols) + [derive_key_column(d.plan, cols, n)]
+                kinds = list(kinds) + [STR]
+                tables = list(tables) + [d.plan.tables[-1]]
             columns = [
                 Column(k, c, t) for k, c, t in zip(kinds, cols, tables)
             ]
@@ -1358,11 +1380,18 @@ def _make_runner_chain(plans, cfg, metrics, lazy_schemas=None) -> Runner:
             if lazy_schemas:
                 saved = lazy_schemas.pop(0)
                 p2.record_kinds.extend(saved["kinds"])
-                for t in saved["tables"]:
+                last = len(saved["tables"]) - 1
+                for ti, t in enumerate(saved["tables"]):
                     if t is None:
                         p2.tables.append(None)
                     else:
-                        table = StringTable()
+                        # a computed-key stage's trailing synthetic
+                        # column restores as a DerivedKeyTable
+                        table = (
+                            DerivedKeyTable()
+                            if p2.synthetic_key and ti == last
+                            else StringTable()
+                        )
                         table.load_state_dict(t)
                         p2.tables.append(table)
                 r2 = Runner(p2, cfg, metrics)
@@ -1377,6 +1406,12 @@ def _make_runner_chain(plans, cfg, metrics, lazy_schemas=None) -> Runner:
             break
         p2.record_kinds.extend(up.program.out_kinds)
         p2.tables.extend(up.program.out_tables)
+        if p2.synthetic_key:
+            # computed KeySelector on this chain stage: the glue
+            # derives the key from each hand-off batch into a trailing
+            # synthetic column
+            p2.record_kinds.append(STR)
+            p2.tables.append(DerivedKeyTable())
         r2 = Runner(p2, cfg, metrics)
         up.chain_to(r2)
         st = up.plan.stateful
@@ -1428,6 +1463,12 @@ def execute_job(env, sink_nodes) -> JobResult:
         for r, cap in zip(stages, ck.key_capacities or []):
             if cap and cap > r.cfg.key_capacity:
                 r._grow_key_capacity(cap)
+        # computed-KeySelector chain stages intern into runtime-built
+        # DerivedKeyTables — reload their snapshots so saved state rows
+        # keep their key ids
+        for r, t in zip(stages, ck.chain_key_tables or []):
+            if t is not None and r.plan.synthetic_key and r.plan.tables:
+                r.plan.tables[-1].load_state_dict(t)
         states = ck.restore_chain([r.program for r in stages])
         for r, s in zip(stages, states):
             r.state = s
@@ -1562,6 +1603,18 @@ def execute_job(env, sink_nodes) -> JobResult:
                 cfg.checkpoint_dir,
                 lazy_schemas=lazy_schemas,
                 key_capacities=[r.cfg.key_capacity for r in stages],
+                # only non-lazy CHAIN stages need this: stage 0's
+                # derived table rides meta["tables"], lazy stages' ride
+                # lazy_schemas
+                chain_key_tables=[
+                    r.plan.tables[-1].state_dict()
+                    if si > 0
+                    and r.plan.synthetic_key
+                    and not getattr(r, "_lazy_schema", False)
+                    and r.plan.tables
+                    else None
+                    for si, r in enumerate(stages)
+                ],
                 state=(
                     [r.state for r in stages]
                     if len(stages) > 1

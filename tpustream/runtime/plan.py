@@ -58,14 +58,16 @@ class _RecordProbe:
         return _FieldProbe(int(i))
 
 
-def selector_callable(key: Any):
-    """The callable behind a ``keyBy`` selector argument, or None.
+def _probe_selector(key: Any):
+    """(callable, probe_result) for a ``keyBy`` selector argument, or
+    (None, None) when no candidate entry point runs.
 
     A KeySelector subclass may override either ``get_key`` or the
     Flink-style ``getKey`` alias; a bare lambda is the callable itself.
-    Probes each candidate with a sentinel record and prefers one that
-    runs (projecting probes return a field sentinel; computed selectors
-    raise on the sentinel but are still valid host-side callables)."""
+    Each candidate is probed ONCE with a sentinel record: a projecting
+    selector returns a field sentinel, a computed selector typically
+    chokes on the sentinel (still a valid host-side callable), and the
+    un-overridden abstract base method raises NotImplementedError."""
     candidates = [
         getattr(key, meth)
         for meth in ("get_key", "getKey")
@@ -75,47 +77,46 @@ def selector_callable(key: Any):
         candidates.append(key)
     for fn in candidates:
         try:
-            fn(_RecordProbe())
-            return fn
+            return fn, fn(_RecordProbe())
         except NotImplementedError:
-            # the un-overridden abstract base method — try the next
             continue
         except Exception:
-            # ran but choked on the probe (computed selector): usable
-            # as a per-record host callable
-            return fn
-    return None
+            return fn, None
+    return None, None
 
 
-def resolve_key_selector(key: Any) -> int:
-    """Turn a ``keyBy`` argument into a tuple field index.
+def classify_key_selector(key: Any):
+    """``("pos", index)`` or ``("computed", callable)`` for a ``keyBy``
+    argument; raises for arguments that are no selector at all.
 
     Flink's surface accepts a field index or a ``KeySelector``; every
     reference job uses indices (chapter2/.../ComputeCpuMax.java:26), and
-    in practice selectors project a field (``r -> r.f1``). The TPU
-    runtime keys on dense interned column ids, so a selector is resolved
-    AT PLAN TIME by probing it with a sentinel record: if it returns one
-    field unchanged, that field's index is the key. Selectors that
-    COMPUTE a derived key raise here; build_plan catches that and falls
-    back to a host-evaluated synthetic key column (plan.synthetic_key).
-    """
+    in practice selectors project a field (``r -> r.f1``) — those
+    resolve AT PLAN TIME to the field's index (the symbolic fast path).
+    A selector that COMPUTES a derived key classifies as computed and
+    runs host-side per record (plan.synthetic_key)."""
     # bool is an int subclass: key_by(True) would silently key on field
     # 1 — reject it as a non-selector instead
     if isinstance(key, int) and not isinstance(key, bool):
-        return key
-    fn = selector_callable(key)
+        return "pos", key
+    fn, out = _probe_selector(key)
     if fn is None:
         raise NotImplementedError(
             f"key_by takes a tuple field index or a KeySelector "
             f"(a callable / get_key | getKey overrider); got "
             f"{type(key).__name__}: {key!r}"
         )
-    try:
-        out = fn(_RecordProbe())
-    except Exception:
-        out = None
     if isinstance(out, _FieldProbe):
-        return out.index
+        return "pos", out.index
+    return "computed", fn
+
+
+def resolve_key_selector(key: Any) -> int:
+    """Strict form of :func:`classify_key_selector`: the field index,
+    or a raise for computed selectors (callers that cannot host-derive)."""
+    kind, val = classify_key_selector(key)
+    if kind == "pos":
+        return val
     raise NotImplementedError(
         "this KeySelector does not project a single record field, so "
         "it must run as a computed (host-evaluated) key"
@@ -328,12 +329,10 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
                     tables = tables[:-1]
                 synthetic_key = False
                 derived_key_fn = None
-            try:
-                key_pos = resolve_key_selector(node.params["key"])
-            except NotImplementedError:
-                fn = selector_callable(node.params["key"])
-                if fn is None:
-                    raise
+            kind, val = classify_key_selector(node.params["key"])
+            if kind == "pos":
+                key_pos = val
+            else:
                 # computed KeySelector: host-evaluate per record into a
                 # synthetic trailing key column (the symbolic fast path
                 # stays for field projections). key_pos = -1 addresses
@@ -348,7 +347,7 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
                         "or add the derived field in the map and key on "
                         "it by index"
                     )
-                derived_key_fn = fn
+                derived_key_fn = val
                 synthetic_key = True
                 if record_kinds:
                     record_kinds = record_kinds + [STR]
@@ -491,6 +490,8 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
     stateful: Optional[StatefulSpec] = None
     pending_window: Optional[Node] = None
     chain_rest: List[Node] = []
+    synthetic_key = False
+    derived_key_fn = None
 
     for i, node in enumerate(rest):
         op = node.op
@@ -504,17 +505,30 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
             if stateful is not None:
                 chain_rest = rest[i:]
                 break
-            try:
-                key_pos = resolve_key_selector(node.params["key"])
-            except NotImplementedError:
-                if selector_callable(node.params["key"]) is not None:
+            if synthetic_key:
+                # a later key_by supersedes the computed key (the
+                # synthetic column is appended at runtime, so only the
+                # flags reset here)
+                synthetic_key = False
+                derived_key_fn = None
+            kind, val = classify_key_selector(node.params["key"])
+            if kind == "pos":
+                key_pos = val
+            else:
+                # computed KeySelector on a CHAIN stage: the chain glue
+                # derives the key host-side from each hand-off batch
+                # (the stage's schema resolves at runtime, so the
+                # synthetic column appends in _make_runner_chain)
+                if any(o == "map" for o, _ in device_pre):
                     raise NotImplementedError(
-                        "a computed KeySelector is supported on the "
-                        "SOURCE stage only; on a chained stage, emit "
-                        "the derived field from the upstream stage and "
-                        "key on it by index"
+                        "a computed KeySelector must follow the re-key "
+                        "hand-off directly (filters in between are "
+                        "fine); add the derived field in the upstream "
+                        "stage instead"
                     )
-                raise
+                derived_key_fn = val
+                synthetic_key = True
+                key_pos = -1
             continue
         if op == "rolling":
             if key_pos is None:
@@ -578,4 +592,6 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
         time_characteristic=env.time_characteristic,
         chain_rest=chain_rest,
         upstream_supplies_ts=True,
+        synthetic_key=synthetic_key,
+        derived_key_fn=derived_key_fn,
     )

@@ -161,7 +161,7 @@ class ProcessWindowProgram(WindowProgram):
         return buf, cnt, overflow, touched, cell
 
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         ring = self.ring
         n = ring.n_slots
         cap = self.cfg.process_buffer_capacity

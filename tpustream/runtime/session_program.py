@@ -259,7 +259,7 @@ class SessionWindowProgram(WindowProgram):
 
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         ring = self.ring
 
         wm_old = state["wm"]
@@ -457,7 +457,7 @@ class SessionProcessProgram(ProcessWindowProgram):
         return s
 
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         ring = self.ring
         n, gap = ring.n_slots, self.gap_ms
         L = self.allowed_lateness_ms

@@ -42,19 +42,27 @@ class BaseProgram:
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         self.plan = plan
         self.cfg = cfg
-        self.pre_chain = DeviceChain(
-            plan.device_pre, plan.record_kinds, plan.tables
-        )
+        # the pre chain (user device ops before the stateful op) wraps
+        # the VISIBLE record: a computed-KeySelector job's synthetic
+        # trailing key column must never reach user filters, so the
+        # chain is built without it and _apply_pre routes it around
+        in_kinds, in_tables = plan.record_kinds, plan.tables
+        if plan.synthetic_key and in_kinds:
+            in_kinds, in_tables = in_kinds[:-1], in_tables[:-1]
+        self.pre_chain = DeviceChain(plan.device_pre, in_kinds, in_tables)
         self.mid_kinds = self.pre_chain.out_kinds
         self.mid_tables = self.pre_chain.out_tables
-        if plan.synthetic_key:
-            # the host-computed derived-key column rides as the LAST
-            # input column up to key extraction only: the VISIBLE mid
-            # schema (user fns, stored state, emissions) excludes it
-            self.mid_kinds = self.mid_kinds[:-1]
-            self.mid_tables = self.mid_tables[:-1]
         # post chain input kinds are set by the subclass (stateful output)
         self.post_chain: Optional[DeviceChain] = None
+
+    def _apply_pre(self, cols, valid):
+        """Run the pre chain over the visible record columns; the
+        synthetic derived-key column (if any) bypasses user ops and
+        reattaches as the trailing column for the exchange."""
+        if self.plan.synthetic_key:
+            out, mask = self.pre_chain.apply(list(cols[:-1]), valid)
+            return list(out) + [cols[-1]], mask
+        return self.pre_chain.apply(cols, valid)
 
     def _split_key_col(self, mid_cols):
         """(visible mid cols, raw key column). Call AFTER the exchange
@@ -310,7 +318,7 @@ class RollingProgram(BaseProgram):
         )
 
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         mid_cols, mask, ts, _ = self._exchange(mid_cols, mask, ts)
         mid_cols, gkeys = self._split_key_col(mid_cols)
         keys = self._local_keys(gkeys)

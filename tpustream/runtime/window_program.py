@@ -843,7 +843,7 @@ class WindowProgram(BaseProgram):
 
     # ------------------------------------------------------------------
     def _step(self, state, cols, valid, ts, wm_lower):
-        mid_cols, mask = self.pre_chain.apply(cols, valid)
+        mid_cols, mask = self._apply_pre(cols, valid)
         ring = self.ring
 
         wm_old = state["wm"]
