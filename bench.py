@@ -664,21 +664,22 @@ def main():
     )
 
     # ---- Phase D: rolling-aggregate config (BASELINE.json config 2) -----
-    # chapter2-style keyed running max at 1M keys, measured with the same
+    # chapter2-style keyed running max, measured with the same
     # chained-scan methodology; failures here never sink the headline
-    rolling_rate = None
-    try:
+    def rolling_device_bench(B_r, K_r, scan_len, warm, timed):
+        """Chained-scan rolling-max benchmark at (batch, keys); returns
+        events/s. Warmup runs past the coupon-collector horizon so the
+        steady-state no-new-keys cond branch is what gets timed."""
         from tpustream.ops import rolling as R
 
-        BR = 1 << 17
         KINDS = ["str", "str", "f64"]
         compact = [False, False, True]
         combine = R.make_combiner("max", 2)
 
         def rgen(i):
-            _, h = stream_hash(i, BR)
-            return (h % K).astype(jnp.int32), (
-                (h % K).astype(jnp.int32),
+            _, h = stream_hash(i, B_r)
+            return (h % K_r).astype(jnp.int32), (
+                (h % K_r).astype(jnp.int32),
                 (h % 8).astype(jnp.int32),
                 (h % 10000).astype(jnp.float64) / 100.0,
             )
@@ -688,7 +689,7 @@ def main():
                 rstate, tot, i = carry
                 keys, rcols = rgen(i)
                 rstate, emis, sv, sk, inv = R.rolling_step(
-                    rstate, keys, rcols, jnp.ones(BR, bool), combine,
+                    rstate, keys, rcols, jnp.ones(B_r, bool), combine,
                     KINDS, compact,
                     rolling_kind="max", rolling_pos=2, key_col=0,
                     key_emit=lambda s: s.astype(jnp.int32),
@@ -697,28 +698,29 @@ def main():
                 return (rstate, tot + emis[2].sum(), i + 1), None
 
             (rstate, tot, i), _ = jax.lax.scan(
-                body, (rstate, tot, i), None, length=100
+                body, (rstate, tot, i), None, length=scan_len
             )
             return rstate, tot, i
 
         rmulti_j = jax.jit(rmulti, donate_argnums=0)
-        rstate = R.init_rolling_state(K, KINDS, compact, sentinel_leaf=1)
+        rstate = R.init_rolling_state(K_r, KINDS, compact, sentinel_leaf=1)
         rtot = jnp.asarray(0.0, jnp.float64)
         ri = jnp.asarray(0, jnp.int64)
-        # warm past the coupon-collector horizon (~K ln K = 14.5M events)
-        # so the steady-state no-new-keys cond branch is what gets timed
-        for _ in range(2):
+        for _ in range(warm):
             rstate, rtot, ri = rmulti_j(rstate, rtot, ri)
         _ = np.asarray(rtot)
         t0 = time.perf_counter()
-        for _ in range(3):
+        for _ in range(timed):
             rstate, rtot, ri = rmulti_j(rstate, rtot, ri)
         _ = np.asarray(rtot)
-        rdt = time.perf_counter() - t0
-        rolling_rate = 300 * BR / rdt
+        return timed * scan_len * B_r / (time.perf_counter() - t0)
+
+    rolling_rate = None
+    try:
+        rolling_rate = rolling_device_bench(1 << 17, K, 100, 2, 3)
         log(
             f"phase D: rolling max (1M keys): {rolling_rate/1e6:.1f}M "
-            f"events/s/chip ({rdt/300*1e3:.2f} ms/step)"
+            f"events/s/chip"
         )
     except Exception as e:  # pragma: no cover
         log(f"phase D skipped: {e}")
@@ -735,56 +737,12 @@ def main():
     # only ~17 B/row, so compute remains the binding stage.
     rolling_shard_rate = None
     try:
-        from tpustream.ops import rolling as R
-
-        BS, KS = (1 << 17) // 8, K // 8
-        KINDS = ["str", "str", "f64"]
-        compact = [False, False, True]
-        combine = R.make_combiner("max", 2)
-
-        def sgen(i):
-            _, h = stream_hash(i, BS)
-            return (h % KS).astype(jnp.int32), (
-                (h % KS).astype(jnp.int32),
-                (h % 8).astype(jnp.int32),
-                (h % 10000).astype(jnp.float64) / 100.0,
-            )
-
-        def smulti(rstate, tot, i):
-            def body(carry, _):
-                rstate, tot, i = carry
-                keys, rcols = sgen(i)
-                rstate, emis, sv, sk, inv = R.rolling_step(
-                    rstate, keys, rcols, jnp.ones(BS, bool), combine,
-                    KINDS, compact,
-                    rolling_kind="max", rolling_pos=2, key_col=0,
-                    key_emit=lambda s: s.astype(jnp.int32),
-                    sentinel_leaf=1,
-                )
-                return (rstate, tot + emis[2].sum(), i + 1), None
-
-            (rstate, tot, i), _ = jax.lax.scan(
-                body, (rstate, tot, i), None, length=200
-            )
-            return rstate, tot, i
-
-        smulti_j = jax.jit(smulti, donate_argnums=0)
-        sstate = R.init_rolling_state(KS, KINDS, compact, sentinel_leaf=1)
-        stot = jnp.asarray(0.0, jnp.float64)
-        si = jnp.asarray(0, jnp.int64)
-        for _ in range(3):  # warm past the per-shard coupon collector
-            sstate, stot, si = smulti_j(sstate, stot, si)
-        _ = np.asarray(stot)
-        t0 = time.perf_counter()
-        for _ in range(3):
-            sstate, stot, si = smulti_j(sstate, stot, si)
-        _ = np.asarray(stot)
-        sdt = time.perf_counter() - t0
-        shard_step_ms = sdt / 600 * 1e3
-        rolling_shard_rate = 600 * BS / sdt
+        rolling_shard_rate = rolling_device_bench(
+            (1 << 17) // 8, K // 8, 200, 3, 3
+        )
         log(
             f"phase D2: rolling at the v5e-8 PER-SHARD shape "
-            f"(B/8={BS}, K/8={KS}): {shard_step_ms:.2f} ms/step -> "
+            f"(B/8={(1 << 17) // 8}, K/8={K // 8}): "
             f"{rolling_shard_rate/1e6:.1f}M events/s/shard; 8-shard "
             f"compute-side aggregate ~{rolling_shard_rate*8/1e6:.0f}M ev/s "
             f"(exchange unmeasurable on 1 chip; ~17 B/row over ICI)"

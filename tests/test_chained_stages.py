@@ -374,6 +374,36 @@ def test_chain_after_process_late_str_after_float_fails_loudly():
         env.execute("late-str-after-float")
 
 
+def test_sliding_window_fed_chain():
+    """A SLIDING stage-1 window feeding a re-key: one record fans into
+    several windows, so the hand-off carries repeated window-end
+    timestamps (end-1 result ts) and same-end multi-key fires — the
+    composition the tumbling-fed tests never produce."""
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, key_capacity=16)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(LINES))
+    handle = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10), Time.seconds(5))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+        .key_by(1)
+        .time_window(Time.seconds(30))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+        .collect()
+    )
+    env.execute("sliding-fed-chain")
+    # stage 1 (10s,5s) sliding sums per key; stage 2 sums per cpu in
+    # 30s tumbling windows of the result timestamps (end - 1):
+    # x gets 5+8+7+9=29 in [0,30s) and 9 in [30,60s); y gets 7+7+4=18
+    assert sorted(tuple(t) for t in handle.items) == [
+        ("a", "x", 29), ("b", "x", 9), ("b", "y", 18),
+    ]
+
+
 def test_chain_equal_ts_fires_split_across_subbatches_not_late():
     """Regression: stage-1 windows fire many same-timestamp results in
     one pump; when they split across stage-2 sub-batches (batch_size

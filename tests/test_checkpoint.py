@@ -38,13 +38,22 @@ def checkpoints(tmpdir):
     return sorted(glob.glob(os.path.join(str(tmpdir), "ckpt-*.npz")))
 
 
-def resume_suffix_check(build, items, tmp_path, time_char=None, **cfg):
+def resume_suffix_check(
+    build, items, tmp_path, time_char=None, check_unperturbed=False, **cfg
+):
     """Every surviving checkpoint must resume to the exact remaining
-    output suffix of an uninterrupted run."""
-    full = run_job(build, items, time_char=time_char, **cfg)
+    output suffix of the checkpointed run.
+
+    ``check_unperturbed`` additionally runs WITHOUT checkpointing and
+    asserts identical output (checkpointing is observation-free). That
+    property is config-independent, so only the two canonical tests
+    assert it — a second full job run per test here was ~a third of the
+    checkpoint suite's wall time (VERDICT r3 next #9)."""
     ckdir = tmp_path / "ck"
-    with_ck = run_job(build, items, tmpdir=ckdir, time_char=time_char, **cfg)
-    assert with_ck == full  # checkpointing must not perturb results
+    full = run_job(build, items, tmpdir=ckdir, time_char=time_char, **cfg)
+    if check_unperturbed:
+        bare = run_job(build, items, time_char=time_char, **cfg)
+        assert full == bare  # checkpointing must not perturb results
     snaps = checkpoints(ckdir)
     assert snaps, "no checkpoints were written"
     for snap in snaps:
@@ -70,7 +79,7 @@ def test_rolling_max_resume(tmp_path):
         "1563452061 10.8.22.2 cpu1 10.0",
         "1563452062 10.8.22.1 cpu0 50.0",
     ]
-    full = resume_suffix_check(build, lines, tmp_path)
+    full = resume_suffix_check(build, lines, tmp_path, check_unperturbed=True)
     # keyed rolling state survives: max re-emits 99.9 (not 50.0) post-resume
     assert [r[2] for r in full] == [80.5, 80.5, 40.0, 99.9, 40.0, 99.9]
 
@@ -88,7 +97,7 @@ def test_windowed_avg_resume(tmp_path):
         "1563452071 10.8.22.1 cpu0 20.0",
         AdvanceProcessingTime(130_000),
     ]
-    full = resume_suffix_check(build, items, tmp_path)
+    full = resume_suffix_check(build, items, tmp_path, check_unperturbed=True)
     assert full == [86.26666666666667, 20.2, 15.0]
 
 
@@ -253,7 +262,7 @@ def test_session_window_resume(tmp_path):
     ]
     full = resume_suffix_check(
         build, lines, tmp_path, time_char=TimeCharacteristic.EventTime,
-        key_capacity=64, alert_capacity=1024,
+        key_capacity=64, alert_capacity=1024, batch_size=4,
     )
     assert sorted((t.f0, t.f1) for t in full) == [
         ("a", 7), ("a", 8), ("a", 64), ("b", 16), ("b", 32),
@@ -305,7 +314,7 @@ def test_session_process_resume(tmp_path):
     ]
     full = resume_suffix_check(
         build, lines, tmp_path, time_char=TimeCharacteristic.EventTime,
-        key_capacity=64, alert_capacity=1024,
+        key_capacity=64, alert_capacity=1024, batch_size=4,
     )
     assert sorted((t.f0, t.f1) for t in full) == [
         ("a", 3.0), ("a", 8.0), ("a", 64.0), ("b", 16.0), ("b", 32.0),
@@ -398,18 +407,18 @@ def rescale_check(
     cfg.setdefault("batch_size", 16)
     cfg.setdefault("key_capacity", 64)
     cfg.setdefault("print_parallelism", 1)
-    full = run_job(
-        build, items, time_char=time_char, parallelism=p_save, **cfg
-    )
-    assert full, "job produced no output"
     ckdir = tmp_path / "ck"
-    with_ck = run_job(
+    full = run_job(
         build, items, tmpdir=ckdir, time_char=time_char,
         parallelism=p_save, **cfg,
     )
-    assert sorted(map(repr, with_ck)) == sorted(map(repr, full))
+    assert full, "job produced no output"
     snaps = checkpoints(ckdir)
     assert snaps, "no checkpoints were written"
+    if len(snaps) > 2:
+        # first + last surviving snapshot: the layout permutation is
+        # snapshot-independent, so two resumes per direction cover it
+        snaps = [snaps[0], snaps[-1]]
     resumed_mid = False
     for snap in snaps:
         ck = load_checkpoint(snap)

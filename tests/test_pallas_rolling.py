@@ -20,7 +20,10 @@ def test_seq_rolling_reduce_matches_oracle(op):
     if not P._supported():
         pytest.skip("pallas unavailable")
     rng = np.random.default_rng(7)
-    B, K = 1024, 512
+    # 3 row-blocks x 2 key-blocks: every kernel code path (block sweep,
+    # in-block sequential RMW, cross-block carry) at interpret-mode
+    # cost the suite budget can afford
+    B, K = 384, 256
     keys = rng.integers(0, K, B, dtype=np.int32).reshape(B // 128, 128)
     vals = (rng.random(B, dtype=np.float32) * 100).reshape(B // 128, 128)
     ident = {"max": -np.inf, "min": np.inf, "sum": 0.0}[op]

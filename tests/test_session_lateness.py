@@ -277,8 +277,14 @@ def test_intra_batch_rescue_closure():
     assert s["late_dropped"] == 0
 
 
-@pytest.mark.parametrize("lateness_ms", [0, 15_000])
-@pytest.mark.parametrize("batch_size", [1, 8])
+@pytest.mark.parametrize(
+    "lateness_ms,batch_size",
+    # record-at-a-time for both lateness settings, plus one batched
+    # combination per setting's interesting side (batch=8 with lateness
+    # exercises intra-batch rescue + refire; batch=8 lateness=0 adds
+    # nothing those three don't cover — wall-time budget, VERDICT r3 #9)
+    [(0, 1), (15_000, 1), (15_000, 8)],
+)
 def test_randomized_stream_matches_flink_oracle(lateness_ms, batch_size):
     rng = np.random.default_rng(11)
     t = 0

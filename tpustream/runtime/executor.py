@@ -251,13 +251,26 @@ def derive_key_column(plan, cols, n: int) -> np.ndarray:
     plan's trailing DerivedKeyTable (per-record Python — the
     correctness lane; field projections take the symbolic path and
     never come here). Used by the host parse stage and by the chain
-    glue when a CHAIN stage keys by a computed selector."""
+    glue when a CHAIN stage keys by a computed selector.
+
+    Filters between the parse map (or re-key hand-off) and the
+    computed key_by run on device AFTER this column is built — but
+    Flink's getKey never sees a filtered-out record, and a partial
+    selector (``100 // r.f2``) must not crash on one. So the same
+    filter predicates evaluate here, host-side, and dropped rows get a
+    placeholder id (the device mask excludes them from all keyed
+    work)."""
     from ..api.tuples import make_tuple
 
     kinds = plan.record_kinds[:-1]
     tables = plan.tables[:-1]
     fn = plan.derived_key_fn  # already resolved to a callable
-    vals = []
+    filters = [
+        as_callable(f, "filter")
+        for op, f in plan.device_pre
+        if op == "filter"
+    ]
+    vals = np.zeros(n, dtype=np.int32)
     for j in range(n):
         fields = []
         for k, t, c in zip(kinds, tables, cols):
@@ -271,8 +284,9 @@ def derive_key_column(plan, cols, n: int) -> np.ndarray:
             else:
                 fields.append(int(v))
         rec = fields[0] if len(fields) == 1 else make_tuple(*fields)
-        vals.append(fn(rec))
-    return plan.tables[-1].intern_values(vals)
+        if all(f(rec) for f in filters):
+            vals[j] = plan.tables[-1].intern_value(fn(rec))
+    return vals
 
 
 def _row_fields(row) -> list:
