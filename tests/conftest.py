@@ -55,9 +55,12 @@ jax.config.update("jax_platforms", "cpu")
 # sixteen; rescale/computed-key resumes sample first+last snapshot),
 # and (d) right-sizing fuzz matrices whose extra points covered no new
 # code path (session-lateness combos, window-oracle seeds,
-# interpret-mode Pallas shapes). Re-measure with `pytest --durations=40`
-# after adding a heavy test; the biggest single items are the two
-# distributed variant packs and the chained/rescale fuzzes.
+# interpret-mode Pallas shapes). Measured after the cuts: 230 tests,
+# 21:26-23:47 across back-to-back runs of the SAME tree — this host's
+# run-to-run variance is ~2.5 min, so treat single-run wall times
+# accordingly. Re-measure with `pytest --durations=40` after adding a
+# heavy test; the biggest single items are the two distributed variant
+# packs and the chained/rescale fuzzes.
 # ---------------------------------------------------------------------------
 
 # whole files whose tests are dominated by multi-second compiles/fuzz
