@@ -404,6 +404,43 @@ def test_sliding_window_fed_chain():
     ]
 
 
+def test_session_fed_chain():
+    """A session-window stage feeding a re-key: merged-session results
+    carry their (variable) end-1 timestamps into the downstream
+    event-time window."""
+    from tpustream import Tuple2
+    from tpustream.api.windows import EventTimeSessionWindows
+
+    lines = [
+        "1000 a 1", "2000 b 2", "3000 a 4", "9000 b 8",
+        "20000 a 16", "22000 b 32", "23000 a 64",
+        "40000 c 100", "55000 c 200",
+    ]
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=4, key_capacity=16, alert_capacity=1024)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines))
+    h = (
+        text.assign_timestamps_and_watermarks(Ts())
+        .map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.seconds(4)))
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .key_by(0)
+        .time_window(Time.seconds(30))
+        .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        .collect()
+    )
+    env.execute("session-fed-chain")
+    # sessions: a=[1k,7k)5 +[20k,27k)80; b=2,8,32 (ends<=26k);
+    # c=100@[40k,44k), 200@[55k,59k). Stage-2 30s windows of end-1:
+    # [0,30k): a 85, b 42; [30k,60k): c 300
+    assert sorted((t.f0, t.f1) for t in h.items) == [
+        ("a", 85), ("b", 42), ("c", 300),
+    ]
+
+
 def test_chain_equal_ts_fires_split_across_subbatches_not_late():
     """Regression: stage-1 windows fire many same-timestamp results in
     one pump; when they split across stage-2 sub-batches (batch_size
