@@ -84,6 +84,76 @@ def test_bool_key_rejected():
         resolve_key_selector(True)
 
 
+def test_branching_selectors_classify_as_computed():
+    """A selector that BRANCHES on a field (truthiness / ordering /
+    equality / membership) computes a key; the plan-time probe must not
+    misread it as a pure projection (ADVICE r4: probe truthiness used
+    to classify ``r.f1 or 'default'`` as ('pos', 1), silently keying
+    every record on f1)."""
+    from tpustream.runtime.plan import classify_key_selector
+
+    branching = [
+        lambda r: r.f1 or "default",                    # __bool__
+        lambda r: r.f1 if r.f2 > 0 else "low",          # ordering
+        lambda r: "special" if r.f0 == "alert" else r.f1,  # __eq__
+        lambda r: "x" if r.f0 in {"a", "b"} else r.f1,  # set: __hash__
+        lambda r: "x" if r.f0 in ("a", "b") else r.f1,  # tuple: __eq__
+    ]
+    for fn in branching:
+        kind, _ = classify_key_selector(fn)
+        assert kind == "computed", fn
+    # pure projections still resolve symbolically
+    assert classify_key_selector(lambda r: r.f1) == ("pos", 1)
+
+
+def test_branching_selector_end_to_end():
+    # the __bool__-guard path, run on data: r.f0 or 'default' groups
+    # falsy keys ('' after strip-to-empty is impossible here, so use a
+    # branch on the value field instead)
+    lines = ["a 1", "b 95", "a 2", "b 96"]
+    got = run(lambda r: r.f0 if r.f1 > 90 else "low", lines=lines)
+    # keys: low(a1), b(95), low(a1+2), b(95+96)
+    assert got == [("a", 1.0), ("b", 95.0), ("a", 3.0), ("b", 191.0)]
+
+
+def test_derived_key_table_reserves_placeholder():
+    """DerivedKeyTable id 0 is a dead slot (ADVICE r4): filter-dropped
+    rows carry it, so even a host/device filter disagreement cannot
+    alias the first REAL derived key's state."""
+    from tpustream.records import DerivedKeyTable
+
+    t = DerivedKeyTable()
+    assert len(t) == 1                      # placeholder pre-interned
+    assert t.intern_value("a") == 1         # real keys start at 1
+    assert t.lookup(1) == "a"
+    # round-trips through checkpoint state
+    t2 = DerivedKeyTable()
+    t2.load_state_dict(t.state_dict())
+    assert t2.intern_value("a") == 1 and t2.lookup(1) == "a"
+
+
+def test_old_format_checkpoint_rejected(tmp_path):
+    """A snapshot written by a different FORMAT_VERSION must fail with
+    the explicit version message, not a downstream leaf-shape error
+    (ADVICE r4: v6 builds vs v7 grown-capacity snapshots)."""
+    import json
+
+    import numpy as np
+
+    from tpustream.runtime.checkpoint import FORMAT_VERSION, load_checkpoint
+
+    meta = {
+        "version": FORMAT_VERSION - 1,
+        "record_kinds": [], "tables": [], "source_pos": 0,
+        "proc_now": 0, "emitted": 0, "batches": 1,
+    }
+    p = tmp_path / "ckpt-0000000001.npz"
+    np.savez(p, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8))
+    with pytest.raises(ValueError, match="version"):
+        load_checkpoint(str(p))
+
+
 # ---------------------------------------------------------------------------
 # computed (derived-key) selectors: host-evaluated fallback
 # ---------------------------------------------------------------------------

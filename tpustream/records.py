@@ -80,9 +80,20 @@ class DerivedKeyTable(StringTable):
     for checkpoints (derived keys must be str/int/float/bool, the
     sensible hashable surface)."""
 
+    # id 0 is a reserved placeholder, interned at construction: filter-
+    # dropped rows in derive_key_column carry it, so a host/device
+    # filter disagreement (float semantics, stateful predicate) routes
+    # a record to this dead slot instead of aliasing the first REAL
+    # derived key's state. The slot counts against key_capacity (ids
+    # index state rows directly), so a computed-key job holds
+    # key_capacity - 1 real keys before the automatic growth rebuild.
+    PLACEHOLDER_ID = 0
+
     def __init__(self) -> None:
         super().__init__()
-        self._originals: List = []
+        self._originals: List = [None]
+        pid = self.intern("\x00reserved:placeholder")
+        assert pid == self.PLACEHOLDER_ID
 
     def intern_value(self, v) -> int:
         if isinstance(v, (np.integer,)):

@@ -42,7 +42,13 @@ import numpy as np
 # every key row as already-seen
 # v6: session state gains cell_fired (allowed-lateness retention); count
 # windows gain element-log programs (ebuf/tot)
-FORMAT_VERSION = 6
+# v7: meta carries lazy_schemas / key_capacities / chain_key_tables and
+# restore may rescale across parallelism or grow capacity (added late in
+# v6's life — the bump makes pre-feature builds reject such snapshots
+# with the version message instead of a leaf-shape ValueError);
+# DerivedKeyTable reserves id 0 as the filter-drop placeholder, shifting
+# every derived key id by one
+FORMAT_VERSION = 7
 _META_KEY = "__meta__"
 
 

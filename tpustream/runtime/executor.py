@@ -257,9 +257,11 @@ def derive_key_column(plan, cols, n: int) -> np.ndarray:
     computed key_by run on device AFTER this column is built — but
     Flink's getKey never sees a filtered-out record, and a partial
     selector (``100 // r.f2``) must not crash on one. So the same
-    filter predicates evaluate here, host-side, and dropped rows get a
-    placeholder id (the device mask excludes them from all keyed
-    work)."""
+    filter predicates evaluate here, host-side, and dropped rows get
+    the table's reserved PLACEHOLDER_ID (the device mask excludes them
+    from all keyed work; the reserved id guarantees that even a
+    host/device filter disagreement cannot alias a real key's
+    state)."""
     from ..api.tuples import make_tuple
 
     kinds = plan.record_kinds[:-1]
@@ -270,7 +272,7 @@ def derive_key_column(plan, cols, n: int) -> np.ndarray:
         for op, f in plan.device_pre
         if op == "filter"
     ]
-    vals = np.zeros(n, dtype=np.int32)
+    vals = np.full(n, DerivedKeyTable.PLACEHOLDER_ID, dtype=np.int32)
     for j in range(n):
         fields = []
         for k, t, c in zip(kinds, tables, cols):

@@ -36,12 +36,50 @@ class HostOp:
 
 class _FieldProbe:
     """Sentinel standing in for one record field during key-selector
-    probing."""
+    probing.
+
+    Truthiness and ordering raise: a selector that BRANCHES on a field
+    (``lambda r: r.f1 or 'default'``, ``r.f1 if r.f2 > 0 else ...``)
+    is computing a key, not projecting one — the raise makes the probe
+    fall through to the 'computed' classification instead of silently
+    keying every record on the probed field."""
 
     __slots__ = ("index",)
 
     def __init__(self, index: int):
         self.index = index
+
+    def _no_probe(self, op: str):
+        raise TypeError(
+            f"KeySelector applies '{op}' to a record field at plan time; "
+            "classifying it as a computed (host-evaluated) key"
+        )
+
+    def __bool__(self):
+        self._no_probe("bool")
+
+    def __eq__(self, other):
+        self._no_probe("==")
+
+    def __ne__(self, other):
+        self._no_probe("!=")
+
+    def __hash__(self):
+        # set/dict membership (`r.f0 in {'a','b'}`) hashes before it
+        # compares — a hash miss would skip __eq__ and dodge the guard
+        self._no_probe("hash")
+
+    def __lt__(self, other):
+        self._no_probe("<")
+
+    def __le__(self, other):
+        self._no_probe("<=")
+
+    def __gt__(self, other):
+        self._no_probe(">")
+
+    def __ge__(self, other):
+        self._no_probe(">=")
 
 
 class _RecordProbe:
