@@ -59,14 +59,13 @@ class _FieldProbe:
         self._no_probe("bool")
 
     def __eq__(self, other):
+        # also covers !=: the default __ne__ delegates here. Defining
+        # __eq__ makes the class unhashable, so set/dict membership
+        # (`r.f0 in {'a','b'}`) raises too — __hash__ below only makes
+        # that error say what happened
         self._no_probe("==")
 
-    def __ne__(self, other):
-        self._no_probe("!=")
-
     def __hash__(self):
-        # set/dict membership (`r.f0 in {'a','b'}`) hashes before it
-        # compares — a hash miss would skip __eq__ and dodge the guard
         self._no_probe("hash")
 
     def __lt__(self, other):
