@@ -93,7 +93,10 @@ def _run(lines, source_kind="lines", **cfg):
     return collections.Counter(tuple(t) for t in handle.items)
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+# one seed: a second seed re-ran the identical code paths for ~23 s
+# (VERDICT r3 next #9 / r4 next #7 gate budget); divergence between
+# configs, not between seeds, is what this test detects
+@pytest.mark.parametrize("seed", [0])
 def test_execution_strategies_are_observationally_identical(seed):
     lines = _stream(seed, n=300)
     # reference point: per-record batches (closest to Flink's
@@ -151,14 +154,101 @@ def build_chained_rolling_window(env, text):
     )
 
 
+def build_chained_session_window(env, text):
+    # session-fed chain: merged-session fires carry variable (end, key)
+    # hand-off order keys; the 4 s gap over _stream's 0-400 ms cadence
+    # closes sessions at the stream gaps and at EOS
+    from tpustream.api.windows import EventTimeSessionWindows
+
+    add = lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2)
+    return (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(1)
+        .window(EventTimeSessionWindows.with_gap(Time.seconds(4)))
+        .reduce(add)
+        .key_by(1)
+        .time_window(Time.seconds(20))
+        .reduce(add)
+    )
+
+
+def build_chained_process_window(env, text):
+    # process()-fed chain: the downstream schema is INFERRED from the
+    # user function's collected rows (mixed int/float medians widen to
+    # f64) and the hand-off rows are host-evaluated fires
+    from tpustream import Tuple2
+
+    def median(key, ctx, elements, out):
+        vals = sorted(e.f2 for e in elements)
+        mid = len(vals) // 2
+        med = (
+            float(vals[mid]) if len(vals) % 2
+            else (vals[mid - 1] + vals[mid]) / 2
+        )
+        out.collect(Tuple2(key, med))
+
+    return (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(1)
+        .time_window(Time.seconds(10))
+        .process(median)
+        .key_by(0)
+        .time_window(Time.seconds(30))
+        .reduce(lambda p, q: type(p)(p.f0, p.f1 + q.f1))
+    )
+
+
+def build_chained_count_window(env, text):
+    # count-fed chain: GlobalWindow results carry no event timestamp,
+    # so the downstream stage windows in processing time (virtual,
+    # replay-deterministic at a fixed batching)
+    from tpustream.api.windows import TumblingProcessingTimeWindows
+
+    add = lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2)
+    return (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(1)
+        .count_window(3)
+        .reduce(add)
+        .key_by(1)
+        .window(TumblingProcessingTimeWindows.of(Time.minutes(5)))
+        .reduce(add)
+    )
+
+
+def build_chained_computed_key(env, text):
+    # computed KeySelector on the chain stage: the glue host-derives +
+    # interns the re-key from each hand-off batch (coarser groups, so
+    # stage 2 genuinely merges across stage-1 keys)
+    add = lambda a, b: Tuple3(a.f0, a.f1, a.f2 + b.f2)
+    return (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(1)
+        .time_window(Time.seconds(10))
+        .reduce(add)
+        .key_by(lambda r: int(r.f1[1:]) % 3)
+        .time_window(Time.seconds(20))
+        .reduce(add)
+    )
+
+
 CHAIN_BUILDERS = {
     "window_window": build_chained_window_window,
     "rolling_window": build_chained_rolling_window,
+    "session_window": build_chained_session_window,
+    "process_window": build_chained_process_window,
+    "count_window": build_chained_count_window,
+    "computed_key": build_chained_computed_key,
 }
 
 
 def _run_chained(builder, lines, source_kind="lines", **cfg):
     cfg.setdefault("batch_size", 16)
+    cfg.setdefault("alert_capacity", 2048)
     env = StreamExecutionEnvironment(StreamConfig(**cfg))
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     if source_kind == "raw":
@@ -176,12 +266,25 @@ def _run_chained(builder, lines, source_kind="lines", **cfg):
 
 
 @pytest.mark.parametrize(
-    "seed,builder", [(11, "window_window"), (12, "rolling_window")]
+    "seed,builder",
+    [
+        (11, "window_window"),
+        (12, "rolling_window"),
+        (13, "session_window"),
+        (14, "process_window"),
+        (15, "count_window"),
+        (16, "computed_key"),
+    ],
 )
 def test_chained_execution_strategies_identical(seed, builder):
-    lines = _stream(seed, n=180)
+    lines = _stream(seed, n=150 if builder in
+                    ("session_window", "process_window") else 180)
     base = _run_chained(builder, lines)
-    assert sum(base.values()) > 10, "chain produced too little output"
+    # count-fed chains legally collapse to one (virtual) processing-time
+    # window per key — 7 outputs; the hand-off traffic fuzzed here is
+    # the stage-1 fires, which number dozens
+    floor = 6 if builder == "count_window" else 10
+    assert sum(base.values()) > floor, "chain produced too little output"
     # pipelining depth is a per-stage emission-fetch strategy already
     # swept single-stage; the chain glue is depth-independent by
     # construction (pump_chain drains buffered entries whole)
