@@ -249,6 +249,9 @@ CHAIN_BUILDERS = {
 def _run_chained(builder, lines, source_kind="lines", **cfg):
     cfg.setdefault("batch_size", 16)
     cfg.setdefault("alert_capacity", 2048)
+    # a truncation would hit base and variants identically — fail loudly
+    # instead of green-lighting lossy results
+    cfg.setdefault("strict_overflow", True)
     env = StreamExecutionEnvironment(StreamConfig(**cfg))
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     if source_kind == "raw":
