@@ -110,6 +110,9 @@ def test_execution_strategies_are_observationally_identical(seed):
         "deep_pipeline": dict(async_depth=8),
         "no_compress": dict(h2d_compress=False),
         "fire_budget": dict(max_fires_per_step=2),
+        # grouped count fetches only shift WHEN emissions are fetched,
+        # never what they contain
+        "grouped_fetch": dict(async_depth=8, fetch_group=4),
     }
     for name, cfg in variants.items():
         got = _run(lines, **cfg)

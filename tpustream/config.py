@@ -90,6 +90,22 @@ class StreamConfig:
     # (full-window process()) force depth 1. Raise past 2 when the
     # link's round-trip latency exceeds a step's device time.
 
+    fetch_group: int = 1
+    # How many in-flight steps' emission-COUNT scalars fetch in ONE
+    # device_get round trip. 1 (default) fetches per step — right for
+    # PCIe hosts where a round trip is microseconds and per-step counts
+    # let the executor skip batch-sized emission buffers immediately.
+    # On a high-latency link (this environment's ~100 ms tunnel RPC),
+    # the per-step scalar fetch IS the binding full-path stage
+    # (BENCH_r04 phase J); grouping G steps amortizes that round trip
+    # G-ways. No emission dispatches later than at G=1 — the oldest
+    # in-flight entry finishes at the same feed either way and the
+    # rest finish earlier; the costs are a longer blocking wait per
+    # finish call and an effective in-flight depth that oscillates by
+    # G. Capped by what is actually in flight, so paced sources (which
+    # drain synchronously) are unaffected. Results are byte-identical
+    # either way — only wall-clock dispatch time shifts.
+
     h2d_compress: bool = True
     # Lossless host->device transfer compression: int64 record columns
     # and timestamps ship as int32 deltas against a per-batch base and

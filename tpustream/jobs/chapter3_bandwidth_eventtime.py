@@ -45,11 +45,12 @@ def parse(s: str) -> Tuple3:
 
 
 def build(env: StreamExecutionEnvironment, text,
-          size: Time = None, slide: Time = None):
+          size: Time = None, slide: Time = None, delay: Time = None):
     size = size or Time.minutes(5)
     slide = slide or Time.seconds(5)
+    delay = delay or Time.minutes(1)
     return (
-        text.assign_timestamps_and_watermarks(IsoTimestampExtractor(Time.minutes(1)))
+        text.assign_timestamps_and_watermarks(IsoTimestampExtractor(delay))
         .map(parse)
         .key_by(1)
         .time_window(size, slide)

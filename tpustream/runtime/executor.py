@@ -406,6 +406,10 @@ class Runner:
         depth = 1 if self.program.emissions_reference_state else cfg.async_depth
         self._max_inflight = max(0, depth - 1)
         self._inflight: List[tuple] = []
+        # rows of the last firing step's 'main' prefix (speculative
+        # count+emission piggyback fetch, _speculative_main); 0 until
+        # the first firing step establishes a scale
+        self._prefix_hint = 0
         # -- multi-host (jax.distributed) SPMD --------------------------
         # every process runs this same executor over the same replayed
         # source; batch rows are globally sharded (each process donates
@@ -817,13 +821,28 @@ class Runner:
         self.metrics.step_times_s.append(sw.elapsed)
         self._inflight.append((emissions, counts, t_batch))
         while len(self._inflight) > self._max_inflight:
-            self._finish(*self._inflight.pop(0))
+            g = self._fetch_group
+            self._finish_group(self._inflight[:g])
+            del self._inflight[:g]
+
+    @property
+    def _fetch_group(self) -> int:
+        """Steps whose count scalars fetch in one device_get round trip
+        (StreamConfig.fetch_group; >1 amortizes a high-latency link's
+        RTT). Multi-host keeps the per-step cadence: the fetch decision
+        drives collective-bearing paths and must stay step-aligned."""
+        if self._multiproc:
+            return 1
+        return max(1, self.cfg.fetch_group)
 
     def drain_inflight(self):
         """Dispatch every pending step's emissions (checkpoint barrier /
         end of stream)."""
-        while self._inflight:
-            self._finish(*self._inflight.pop(0))
+        if self._inflight:
+            entries, self._inflight = self._inflight, []
+            g = self._fetch_group
+            for s in range(0, len(entries), g):
+                self._finish_group(entries[s : s + g])
 
     def chain_to(self, downstream: "Runner"):
         self.downstream = downstream
@@ -1086,45 +1105,120 @@ class Runner:
             r.pump_chain(proc_now)
             r = r.downstream
 
+    def _plan_fetch(self, emissions, cnts) -> dict:
+        """The emission streams worth fetching for one step, given its
+        host-side count scalars (skip empty streams; slice prefix-
+        compacted buffers to ~count rows)."""
+        fetch = {}
+        for name, stream in emissions.items():
+            c = cnts.get(name, 1)
+            if not c or (name == "late" and not self.side_sinks):
+                continue
+            if (
+                name == "main"
+                and self.program.main_emission_prefix
+                and self.cfg.parallelism <= 1
+                # sharded emissions stack one prefix PER SHARD —
+                # the global buffer has no single count-row prefix
+            ):
+                # valid rows are a compacted prefix: fetch the next
+                # power-of-two past the count, not the whole
+                # alert_capacity buffer (bucketing keeps the number
+                # of device slice programs bounded)
+                cap = int(stream["mask"].shape[0])
+                b = min(cap, 1 << max(4, (int(c) - 1).bit_length()))
+                stream = self._slice_stream(stream, b, cap)
+            fetch[name] = stream
+        return fetch
+
     def _finish(self, emissions, counts, t_batch):
+        self._finish_group([(emissions, counts, t_batch)])
+
+    @staticmethod
+    def _slice_stream(stream, b: int, cap: int):
+        return jax.tree_util.tree_map(
+            lambda a: a[:b]
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] == cap
+            else a,
+            stream,
+        )
+
+    def _spec_eligible(self, entries) -> bool:
+        """Speculation / prefix-hint eligibility: the single-entry
+        (paced/sync) path on single-chip prefix-compacted programs.
+        One predicate for both the hint recorder and the speculative
+        fetch — they must agree or hints are recorded for steps that
+        can never use them."""
+        return (
+            len(entries) == 1
+            and not self._multiproc
+            and self.program.main_emission_prefix
+            and self.cfg.parallelism <= 1
+            and entries[0][0].get("main") is not None
+        )
+
+    def _speculative_main(self, entries):
+        """For the single-entry (paced/sync) path on prefix-compacted
+        programs: a slice of the 'main' stream sized by the PREVIOUS
+        firing step's count, fetched in the same round trip as the count
+        scalars. When the hint covers the actual count, a firing step
+        costs ONE link round trip instead of two — on a ~100 ms-RTT
+        tunnel that halves the alert-path fetch latency; on PCIe the
+        saving is noise and the speculative bytes are bounded by the
+        hint. Returns (stream_slice, hint_rows) or (None, 0)."""
+        if not self._spec_eligible(entries) or not self._prefix_hint:
+            return None, 0
+        main = entries[0][0]["main"]
+        cap = int(main["mask"].shape[0])
+        b = min(cap, self._prefix_hint)
+        return self._slice_stream(main, b, cap), b
+
+    def _finish_group(self, entries):
         # the blocking waits live here, not in _run_step (dispatch is
         # async) — time them into step_times_s so summary()'s
-        # device_time_s still reflects device + transfer occupancy
+        # device_time_s still reflects device + transfer occupancy.
+        # All entries' count scalars fetch in ONE device_get (one link
+        # round trip however many steps the group covers), then all
+        # still-needed emission streams fetch in a second one; dispatch
+        # order is unchanged.
         with Stopwatch() as sw:
-            cnts = jax.device_get(counts)
-            fetch = {}
-            for name, stream in emissions.items():
-                c = cnts.get(name, 1)
-                if not c or (name == "late" and not self.side_sinks):
-                    continue
-                if (
-                    name == "main"
-                    and self.program.main_emission_prefix
-                    and self.cfg.parallelism <= 1
-                    # sharded emissions stack one prefix PER SHARD —
-                    # the global buffer has no single count-row prefix
-                ):
-                    # valid rows are a compacted prefix: fetch the next
-                    # power-of-two past the count, not the whole
-                    # alert_capacity buffer (bucketing keeps the number
-                    # of device slice programs bounded)
-                    cap = int(stream["mask"].shape[0])
-                    b = min(cap, 1 << max(4, (int(c) - 1).bit_length()))
-                    stream = jax.tree_util.tree_map(
-                        lambda a: a[:b]
-                        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == cap
-                        else a,
-                        stream,
-                    )
-                fetch[name] = stream
-            if not fetch:
-                fetched = {}
-            elif self._multiproc:
-                fetched = self._fetch_local(fetch)
+            spec, spec_rows = self._speculative_main(entries)
+            if spec is not None:
+                cnts0, spec_fetched = jax.device_get(
+                    [entries[0][1], spec]
+                )
+                cnts_list = [cnts0]
             else:
-                fetched = jax.device_get(fetch)
+                cnts_list = jax.device_get([c for _, c, _ in entries])
+            fetches = [
+                self._plan_fetch(em, cnts)
+                for (em, _, _), cnts in zip(entries, cnts_list)
+            ]
+            pre_fetched: List[dict] = [{} for _ in fetches]
+            if self._spec_eligible(entries):
+                c = int(cnts_list[0].get("main", 0))
+                if c:
+                    # track the recent firing scale (pow2 bucket, one
+                    # level of headroom) so the next speculation fits it
+                    self._prefix_hint = min(
+                        int(entries[0][0]["main"]["mask"].shape[0]),
+                        1 << max(5, (c - 1).bit_length() + 1),
+                    )
+                if spec is not None and c and c <= spec_rows:
+                    pre_fetched[0]["main"] = spec_fetched
+                    del fetches[0]["main"]
+            if not any(fetches):
+                fetched_list = [{} for _ in fetches]
+            elif self._multiproc:
+                fetched_list = [
+                    self._fetch_local(f) if f else {} for f in fetches
+                ]
+            else:
+                fetched_list = jax.device_get(fetches)
         self.metrics.step_times_s.append(sw.elapsed)
-        self._dispatch(fetched, t_batch)
+        for (entry, pre, fetched) in zip(entries, pre_fetched, fetched_list):
+            fetched.update(pre)
+            self._dispatch(fetched, entry[2])
 
     def finalize_metrics(self):
         """Fold the device-side cumulative counters into Metrics (one
@@ -1497,10 +1591,14 @@ def execute_job(env, sink_nodes) -> JobResult:
     # Emission pipelining helps only when batches arrive back to back; a
     # PACED source (steady-rate feed with idle gaps) would otherwise see
     # its results parked in the in-flight window for async_depth batch
-    # intervals — latency inflating as the rate drops. When the gap
-    # since the previous batch exceeds one pipelining quantum, fetch
-    # synchronously: the link is idle anyway.
-    t_last_feed: Optional[float] = None
+    # intervals — latency inflating as the rate drops. When the time
+    # spent WAITING INSIDE THE SOURCE for the next batch exceeds one
+    # pipelining quantum, fetch synchronously: the link is idle anyway.
+    # (The wait is measured from the end of the previous loop body to
+    # the source's yield — NOT feed-to-feed wall time, which includes
+    # batch processing and misreads a slow link's flood as paced,
+    # forcing a full drain every batch.)
+    t_iter_done: Optional[float] = None
     IDLE_GAP_S = 0.05
 
     def wm_lower_for_records(wm_hint: Optional[int]) -> int:
@@ -1511,6 +1609,11 @@ def execute_job(env, sink_nodes) -> JobResult:
         return LONG_MIN + 1
 
     for sb in plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms):
+        src_gap = (
+            time.perf_counter() - t_iter_done
+            if t_iter_done is not None
+            else 0.0
+        )
         if skip_lines > 0 and sb.n_records:
             # resume: drop source lines the checkpointed run already consumed
             take = min(skip_lines, sb.n_records)
@@ -1565,10 +1668,9 @@ def execute_job(env, sink_nodes) -> JobResult:
             # deterministic pipelined path instead.
             idle = (
                 jax.process_count() == 1
-                and t_last_feed is not None
-                and hw.t0 - t_last_feed > IDLE_GAP_S
+                and t_iter_done is not None
+                and src_gap > IDLE_GAP_S
             )
-            t_last_feed = hw.t0
             runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
             if idle:
                 runner.drain_inflight()
@@ -1644,6 +1746,7 @@ def execute_job(env, sink_nodes) -> JobResult:
                 job_name=env.job_name,
                 parallelism=max(1, cfg.parallelism),
             )
+        t_iter_done = time.perf_counter()
         if sb.final:
             break
 
