@@ -129,7 +129,9 @@ class _GenBytesSource:
                     if now < t_sched0:
                         time.sleep(t_sched0 - now)
                         now = t_sched0
-                    else:
+                    elif self.t_steady_start is not None:
+                        # STEADY-state slip only: the warm segment's
+                        # one-off jit compile is not backpressure
                         self.max_behind_s = max(
                             self.max_behind_s, now - t_sched0
                         )
@@ -242,6 +244,9 @@ def full_path_flagship(rate=None, nbuf=200, warm=80, fill_ms=None,
         async_depth=async_depth,
         fetch_group=fetch_group,
         max_batch_delay_ms=0.0,
+        # flood: overlap parse with the link (paced runs keep the
+        # inline host stage — latency attribution stays exact)
+        parse_ahead=0 if rate else 2,
     )
     env = StreamExecutionEnvironment(cfg)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
@@ -276,6 +281,7 @@ def full_path_ch1(rate=None, nbuf=65, warm=5, fill_ms=None,
     cfg = StreamConfig(
         batch_size=src.rows_per_batch, async_depth=async_depth,
         fetch_group=fetch_group, max_batch_delay_ms=0.0,
+        parse_ahead=0 if rate else 2,
     )
     env = StreamExecutionEnvironment(cfg)
     alerts = []
@@ -894,19 +900,26 @@ def measure_h2d():
     ]
     consume = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
     _ = np.asarray(consume(jax.device_put(arrs[0], dev)))
-    best = 0.0
-    for _ in range(3):  # best-of-3: the ceiling is capacity, and the
-        #                 tunnel's minute-to-minute sag is not it
+    rates = []
+    for _ in range(3):
         t0 = time.perf_counter()
         accs = [consume(jax.device_put(a, dev)) for a in arrs]
         tot = accs[0]
         for a in accs[1:]:
             tot = tot + a
         _ = np.asarray(tot)
-        best = max(
-            best, len(arrs) * one_mb / (time.perf_counter() - t0) / 1e6
+        rates.append(
+            len(arrs) * one_mb / (time.perf_counter() - t0) / 1e6
         )
-    return best
+    # median-of-3 = the SUSTAINED rate a flood can actually ride;
+    # the burst max is logged for context but overstates capacity
+    rates.sort()
+    log(
+        f"phase H detail: pipelined H2D passes "
+        f"{', '.join(f'{r:.0f}' for r in rates)} MB/s "
+        f"(median reported; burst max {rates[-1]:.0f})"
+    )
+    return rates[1]
 
 
 def main():
@@ -1191,11 +1204,11 @@ def main():
     ch1_sus = None
     ch1_curve = None
     try:
-        f1 = full_path_ch1(fetch_group=8, async_depth=8)
+        f1 = full_path_ch1(fetch_group=16, async_depth=16)
         ch1_rate = f1["rate"]
         log(
             f"phase F1: ch1 full path FLOOD (execute_job, raw bytes, "
-            f"fetch_group=8): {ch1_rate/1e6:.2f}M events/s, "
+            f"fetch_group=16): {ch1_rate/1e6:.2f}M events/s, "
             f"{f1['alerts']} alerts"
         )
         log(f"phase F1 summary: {f1['summary']}")
@@ -1220,12 +1233,12 @@ def main():
     flag_curve = None
     g1_perstep_rate = None
     try:
-        g1 = full_path_flagship(fetch_group=8, async_depth=8)
+        g1 = full_path_flagship(fetch_group=16, async_depth=16)
         full_rate, full_p99 = g1["rate"], g1["p99_ms"]
         p99_txt = f"{full_p99:.0f} ms" if full_p99 is not None else "n/a"
         log(
             f"phase G1: flagship full path FLOOD (execute_job, raw bytes, "
-            f"event time, fetch_group=8): {full_rate/1e6:.2f}M events/s, "
+            f"event time, fetch_group=16): {full_rate/1e6:.2f}M events/s, "
             f"p99 ingest->alert {p99_txt} (queueing artifact under flood — "
             f"see G2 for the steady-state figure), {g1['alerts']} alerts"
         )
@@ -1233,7 +1246,7 @@ def main():
         # the per-step-fetch comparison run names the lever's size —
         # identical knobs except fetch_group, so the ratio isolates it
         g1p = full_path_flagship(
-            fetch_group=1, async_depth=8, nbuf=100, warm=40
+            fetch_group=1, async_depth=16, nbuf=100, warm=40
         )
         g1_perstep_rate = g1p["rate"]
         log(

@@ -116,6 +116,19 @@ def test_ch3_eventtime_sliding_resume(tmp_path):
     )
 
 
+def test_resume_with_parse_ahead(tmp_path):
+    """parse_ahead moves the resume line-skip onto the parser thread;
+    exactly-once must hold identically (interning is deterministic, so
+    the parser running ahead of the fed position is observation-free)."""
+    from tpustream.jobs.chapter2_max import build
+
+    lines = [
+        f"15634520{i:02d} 10.8.22.{i % 5} cpu0 {50 + (i * 31) % 47}.5"
+        for i in range(12)
+    ]
+    resume_suffix_check(build, lines, tmp_path, parse_ahead=2)
+
+
 def test_restore_rejects_config_mismatch(tmp_path):
     from tpustream.jobs.chapter2_max import build
 

@@ -113,6 +113,8 @@ def test_execution_strategies_are_observationally_identical(seed):
         # grouped count fetches only shift WHEN emissions are fetched,
         # never what they contain
         "grouped_fetch": dict(async_depth=8, fetch_group=4),
+        # source+parse on its own thread: pure pipelining, same output
+        "parse_ahead": dict(parse_ahead=2),
     }
     for name, cfg in variants.items():
         got = _run(lines, **cfg)

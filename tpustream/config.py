@@ -106,6 +106,18 @@ class StreamConfig:
     # drain synchronously) are unaffected. Results are byte-identical
     # either way — only wall-clock dispatch time shifts.
 
+    parse_ahead: int = 0
+    # Source+parse pipelining depth: >0 moves the host stage (source
+    # read, line skip on resume, parse + intern) onto its own thread
+    # with a bounded hand-off queue, overlapping batch N+1's parse with
+    # batch N's H2D/device work — the reference's threading model
+    # (Flink's source runs as its own operator thread; SURVEY.md §3.1).
+    # 0 (default) keeps the single-threaded loop. Single-process only
+    # (multi-host keeps the deterministic inline path). Safe with
+    # checkpoint/resume: interning is replay-deterministic, so a parser
+    # running <= parse_ahead batches ahead of the fed position only
+    # pre-interns ids a resumed run would re-derive identically.
+
     h2d_compress: bool = True
     # Lossless host->device transfer compression: int64 record columns
     # and timestamps ship as int32 deltas against a per-batch base and

@@ -1131,9 +1131,6 @@ class Runner:
             fetch[name] = stream
         return fetch
 
-    def _finish(self, emissions, counts, t_batch):
-        self._finish_group([(emissions, counts, t_batch)])
-
     @staticmethod
     def _slice_stream(stream, b: int, cap: int):
         return jax.tree_util.tree_map(
@@ -1542,6 +1539,52 @@ def _make_runner_chain(plans, cfg, metrics, lazy_schemas=None) -> Runner:
     return runner
 
 
+def _prefetch_iter(it, depth: int):
+    """Drain ``it`` on a daemon thread into a bounded queue (size =
+    ``depth``): the producer blocks when the consumer falls behind
+    (bounded memory, natural backpressure), and producer exceptions
+    re-raise at the consumer. Used for StreamConfig.parse_ahead."""
+    import queue as queue_mod
+    import threading
+
+    q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        # bounded-put that gives up when the consumer abandoned the
+        # generator (exception in the consuming loop): without the stop
+        # check the producer would block on a full queue forever,
+        # pinning the source iterator and parsed batches
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for item in it:
+                if not put(("item", item)):
+                    return
+            put(("done", None))
+        except BaseException as e:  # surfaces in the consumer
+            put(("err", e))
+
+    threading.Thread(target=run, daemon=True).start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "done":
+                return
+            if kind == "err":
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+
+
 def execute_job(env, sink_nodes) -> JobResult:
     cfg = env.config
     plans = build_plan_chain(env, sink_nodes)
@@ -1608,15 +1651,16 @@ def execute_job(env, sink_nodes) -> JobResult:
             return wm_hint
         return LONG_MIN + 1
 
-    for sb in plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms):
-        src_gap = (
-            time.perf_counter() - t_iter_done
-            if t_iter_done is not None
-            else 0.0
-        )
-        if skip_lines > 0 and sb.n_records:
+    skip_state = [skip_lines]
+
+    def _prepare(sb):
+        """Resume line-skip + host parse for one source batch — the
+        host stage. Runs inline, or on the parse-ahead thread
+        (StreamConfig.parse_ahead), which sequences these calls itself,
+        so skip_state stays single-writer either way."""
+        if skip_state[0] > 0 and sb.n_records:
             # resume: drop source lines the checkpointed run already consumed
-            take = min(skip_lines, sb.n_records)
+            take = min(skip_state[0], sb.n_records)
             if sb.raw is not None:
                 if take == sb.n_raw:
                     rest = b""
@@ -1634,8 +1678,8 @@ def execute_job(env, sink_nodes) -> JobResult:
                     sb.lines[take:], sb.proc_ts[take:], sb.advance_proc_to,
                     sb.final,
                 )
-            skip_lines -= take
-        lines_consumed += sb.n_records
+            skip_state[0] -= take
+        batch = wm_hint = None
         with Stopwatch() as hw:
             if sb.raw is not None:
                 batch, wm_hint = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
@@ -1652,6 +1696,27 @@ def execute_job(env, sink_nodes) -> JobResult:
                     batch, wm_hint = host.process(lines, sb.proc_ts)
             else:
                 batch, wm_hint = host.process(sb.lines, sb.proc_ts)
+        return sb, batch, wm_hint, hw
+
+    prepared = map(
+        _prepare, plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms)
+    )
+    prefetched = cfg.parse_ahead > 0 and jax.process_count() == 1
+    if prefetched:
+        # source + parse on their own thread (the reference's source-
+        # operator thread): batch N+1 parses while N crosses the link
+        prepared = _prefetch_iter(prepared, cfg.parse_ahead)
+
+    for sb, batch, wm_hint, hw in prepared:
+        # idle reference: inline, parse START (hw.t0) — the wait inside
+        # the source, EXCLUDING parse time (a slow parse must not read
+        # as a paced gap); prefetched, the consumer-side wait (parse
+        # overlaps, so time spent blocked on the queue IS source idle)
+        now_ref = time.perf_counter() if prefetched else hw.t0
+        src_gap = (
+            now_ref - t_iter_done if t_iter_done is not None else 0.0
+        )
+        lines_consumed += sb.n_records
         metrics.host_times_s.append(hw.elapsed)
         metrics.batches += 1
         if sb.proc_ts.size:
