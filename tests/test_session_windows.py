@@ -273,3 +273,49 @@ def test_session_aggregate_function():
     )
     env.execute("SessionCount")
     assert sorted(h.items) == [1, 2]
+
+
+def test_session_aggregate_keep_first_acc_stays_on_generic_path():
+    """An AGGREGATE accumulator whose merge passes a leaf through is
+    keep-first semantics, not a cell-invariant key — the scatter-reduce
+    fast path must NOT classify it as the key leaf (a non-unique
+    scatter-set would pick an arbitrary writer). Regression for the
+    round-5 fast-path guard: acc = (first value seen, int total)."""
+    from tpustream import AggregateFunction
+
+    class FirstAndTotal(AggregateFunction):
+        def create_accumulator(self):
+            return Tuple2(-1, 0)
+
+        def add(self, value, accumulator):
+            import jax.numpy as jnp
+
+            first = jnp.where(
+                accumulator.f1 == 0, value.f1, accumulator.f0
+            )
+            return Tuple2(first, accumulator.f1 + value.f1)
+
+        def get_result(self, accumulator):
+            return accumulator
+
+        def merge(self, a, b):
+            return Tuple2(a.f0, a.f1 + b.f1)  # f0 = keep a's first
+
+    recs = [(0, "a", 7), (1_000, "a", 3), (2_000, "a", 5), (40_000, "a", 1)]
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=1, key_capacity=16, alert_capacity=64)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines_of(recs)))
+    h = (
+        text.assign_timestamps_and_watermarks(TsExtractor())
+        .map(parse)
+        .key_by(0)
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds(GAP_MS)))
+        .aggregate(FirstAndTotal())
+        .collect()
+    )
+    env.execute("SessionFirstTotal")
+    # first session: first=7 (arrival order), total=15; the 40 s record
+    # opens a second session that fires at EOS with first=1, total=1
+    assert sorted((t.f0, t.f1) for t in h.items) == [(1, 1), (7, 15)]

@@ -190,6 +190,9 @@ def session_retarget(
     init_leaves: Sequence,
     cell_fired=None,
     lateness_ms: int = 0,
+    ts_base=None,
+    mn_clear=TS_MAX,
+    mx_clear=W0,
 ):
     """Advance the ring to (hi-N, hi]; stale slots are cleared.
 
@@ -197,20 +200,34 @@ def session_retarget(
     + lateness > wm`` — unfired windows before lateness, refire-eligible
     retained cells within it) counts toward ``evicted_unfired`` (ring
     undersized for the session length / lateness horizon).
-    """
+
+    ``ts_base`` ([N] int64): when the boundary planes store
+    pane-RELATIVE int32 offsets (SessionWindowProgram's scatter-reduce
+    fast path), the per-slot absolute base to reconstruct ``cell_max``
+    for the retention test; ``mn_clear``/``mx_clear`` are then the
+    int32 clear identities."""
     from .panes import slot_targets
 
     target = slot_targets(hi_pane, ring)
     stale = slot_pane != target              # [N]
+    cell_max_abs = (
+        cell_max
+        if ts_base is None
+        else ts_base[None, :] + cell_max.astype(jnp.int64)
+    )
     unfired_cell = (
         stale[None, :]
         & (cnt > 0)
-        & (cell_max + gap_ms - 1 + lateness_ms > wm)
+        & (cell_max_abs + gap_ms - 1 + lateness_ms > wm)
     )
     evicted = jnp.sum(jnp.where(unfired_cell, cnt, 0)).astype(jnp.int64)
     cnt = jnp.where(stale[None, :], 0, cnt)
-    cell_min = jnp.where(stale[None, :], TS_MAX, cell_min)
-    cell_max = jnp.where(stale[None, :], W0, cell_max)
+    cell_min = jnp.where(
+        stale[None, :], jnp.asarray(mn_clear, cell_min.dtype), cell_min
+    )
+    cell_max = jnp.where(
+        stale[None, :], jnp.asarray(mx_clear, cell_max.dtype), cell_max
+    )
     acc_leaves = [
         jnp.where(stale[None, :], init, a)
         for a, init in zip(acc_leaves, init_leaves)

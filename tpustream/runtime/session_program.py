@@ -59,6 +59,104 @@ class SessionWindowProgram(WindowProgram):
                 "functions (the surface the reference documents)"
             )
         super().__init__(plan, cfg)
+        self._analyze_session_fast()
+
+    def _analyze_session_fast(self) -> None:
+        """Scatter-reduce fast path eligibility for the typed session
+        cells (round 5 — the per-batch sort + segmented scan +
+        read-modify-write gathers into the [K, N] planes were measured
+        as ~85% of the session step on v5e): when EVERY accumulator
+        leaf is either a commutative primitive (add/min/max, detected
+        syntactically on the combiner's jaxpr — ops/liveness.py) or the
+        cell-invariant projected KEY column (all writers to a cell
+        carry the same key id), the batch merges with one non-unique
+        scatter-reduce per plane — no sort, no scan, no gathers.
+        Identity-initialized planes make merge == reduce; the generic
+        path ignores unoccupied-cell values, so identity init is safe
+        for both."""
+        from ..ops import liveness
+        from .window_program import _dummy_scalar
+
+        arity = len(self.acc_kinds)
+        dummies = [_dummy_scalar(k) for k in self.acc_kinds]
+
+        def combine_probe(*ab):
+            return self.combine(tuple(ab[:arity]), tuple(ab[arity:]))
+
+        try:
+            ops = liveness.leaf_algebraic_ops(combine_probe, dummies, arity)
+            pt = liveness.passthrough_outputs(
+                combine_probe, dummies + dummies, arity
+            )
+        except Exception:
+            # an untraceable combiner simply keeps the generic path
+            self._sess_ops = [None] * arity
+            self._sess_key_leaf = None
+            self._sess_fast = False
+            return
+        # reduce only: reduce accumulators ARE the record, so leaf
+        # key_pos is the key column (cell-invariant — every writer to a
+        # cell carries the same id). An AGGREGATE accumulator's leaf at
+        # that index is arbitrary; a passthrough there is keep-first
+        # semantics, which a non-unique scatter-set would corrupt
+        # (same guard as _analyze_columns' key_leaf)
+        self._sess_key_leaf = (
+            self.key_pos
+            if self.apply_kind == "reduce"
+            and not self.plan.synthetic_key
+            and self.key_pos < arity
+            and pt[self.key_pos]
+            else None
+        )
+        self._sess_ops = ops
+
+        def leaf_ok(i: int) -> bool:
+            if i == self._sess_key_leaf:
+                return True
+            if ops[i] in ("min", "max"):
+                return True  # order-free for every dtype
+            if ops[i] == "add":
+                # a non-unique scatter-add folds in UNSPECIFIED order:
+                # exact for integers, but float sums would drift from
+                # the generic path's arrival-order fold (and from the
+                # reference's Java-double golden outputs) — floats keep
+                # the ordered path
+                return np.issubdtype(
+                    np.dtype(self._acc_dtype(self.acc_kinds[i])),
+                    np.integer,
+                )
+            return False
+
+        self._sess_fast = all(leaf_ok(i) for i in range(arity))
+        # pane-RELATIVE int32 boundary planes: a 64-bit-value scatter
+        # costs ~6.6x a 32-bit one on v5e (measured), and cell_min/max
+        # are two of the six scatters per batch. A record's offset
+        # within its cell's pane is < pane_ms (= gap), so gaps under
+        # ~24.8 days store as int32 offsets; absolute timestamps
+        # reconstruct as pane * pane_ms + rel at every read site
+        self._rel_ts = bool(self._sess_fast and self.ring.pane_ms < 2**31)
+
+    _REL_MIN_IDENT = 2**31 - 1
+    _REL_MAX_IDENT = -(2**31)
+
+    def _sess_init_leaves(self):
+        """Per-acc-leaf initial/reset scalar: the combiner's identity on
+        the fast path (scatter-min/max must meet max/min-of-dtype in
+        unoccupied cells; _plane_identity maps add/key/generic to 0),
+        zero otherwise (the generic path never reads unoccupied
+        values)."""
+        import numpy as np
+
+        out = []
+        for i, kd in enumerate(self.acc_kinds):
+            dt = np.dtype(self._acc_dtype(kd))
+            op = (
+                self._sess_ops[i]
+                if self._sess_fast and i != self._sess_key_leaf
+                else None
+            )
+            out.append(jnp.asarray(self._plane_identity(dt, op), dtype=dt))
+        return out
 
     # WindowProgram.__init__ builds the ring from spec.size/slide; give it
     # a session-shaped ring instead: panes of gap ms, 1 pane per "window",
@@ -85,9 +183,11 @@ class SessionWindowProgram(WindowProgram):
         k, n = self.cfg.key_capacity, self.ring.n_slots
         hi0 = jnp.asarray(-1, dtype=jnp.int64)
         return {
+            # identity-initialized (not zero): the scatter-reduce fast
+            # path merges straight into unoccupied cells
             "acc": [
-                jnp.zeros((k, n), dtype=self._acc_dtype(kd))
-                for kd in self.acc_kinds
+                jnp.full((k, n), init, dtype=init.dtype)
+                for init in self._sess_init_leaves()
             ],
             "cnt": jnp.zeros((k, n), dtype=jnp.int32),
             "slot_pane": pane_ops.slot_targets(hi0, self.ring),
@@ -97,8 +197,16 @@ class SessionWindowProgram(WindowProgram):
             "evicted_unfired": jnp.zeros((), dtype=jnp.int64),
             "alert_overflow": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
-            "cell_min": jnp.full((k, n), TS_MAX, dtype=jnp.int64),
-            "cell_max": jnp.full((k, n), W0, dtype=jnp.int64),
+            "cell_min": (
+                jnp.full((k, n), self._REL_MIN_IDENT, dtype=jnp.int32)
+                if self._rel_ts
+                else jnp.full((k, n), TS_MAX, dtype=jnp.int64)
+            ),
+            "cell_max": (
+                jnp.full((k, n), self._REL_MAX_IDENT, dtype=jnp.int32)
+                if self._rel_ts
+                else jnp.full((k, n), W0, dtype=jnp.int64)
+            ),
             # True on cells of sessions that already fired and are
             # retained for allowed-lateness refires; a record landing in
             # (or merging with) such a cell resets it to dirty
@@ -118,11 +226,69 @@ class SessionWindowProgram(WindowProgram):
     grow_key_leaf = BaseProgram.grow_key_leaf
 
     # ------------------------------------------------------------------
+    def _scatter_session_fast(self, state, keys, mid_cols, live, pane, ts):
+        """One non-unique scatter-reduce per plane (no sort / scan /
+        gathers — see _analyze_session_fast): add/min/max leaves reduce
+        commutatively, the key plane and the fired flag take constant
+        writes (every writer to a cell carries the same value), cnt
+        scatter-adds ones, and the min/max timestamp planes scatter-
+        reduce the record timestamps."""
+        k, n = self.local_key_capacity, self.ring.n_slots
+        slot = jnp.mod(pane, n)
+        flat = jnp.where(
+            live, keys.astype(jnp.int64) * n + slot, jnp.int64(k * n)
+        )
+        lifted = tuple(self.lift(list(mid_cols)))
+        new_acc = []
+        for i, (a, col) in enumerate(zip(state["acc"], lifted)):
+            v = col.astype(a.dtype)
+            fa = a.reshape(-1)
+            if i == self._sess_key_leaf:
+                out = fa.at[flat].set(v, mode="drop")
+            elif self._sess_ops[i] == "add":
+                out = fa.at[flat].add(v, mode="drop")
+            elif self._sess_ops[i] == "min":
+                out = fa.at[flat].min(v, mode="drop")
+            else:
+                out = fa.at[flat].max(v, mode="drop")
+            new_acc.append(out.reshape(k, n))
+        if self._rel_ts:
+            # boundary planes store pane-relative int32 offsets (see
+            # _analyze_session_fast): 32-bit value scatters
+            tv = (ts - pane * self.ring.pane_ms).astype(jnp.int32)
+        else:
+            tv = ts
+        cmin = (
+            state["cell_min"].reshape(-1).at[flat].min(tv, mode="drop")
+            .reshape(k, n)
+        )
+        cmax = (
+            state["cell_max"].reshape(-1).at[flat].max(tv, mode="drop")
+            .reshape(k, n)
+        )
+        cfired = (
+            state["cell_fired"].reshape(-1)
+            .at[flat]
+            .set(jnp.zeros_like(live), mode="drop")
+            .reshape(k, n)
+        )
+        cnt = (
+            state["cnt"].reshape(-1)
+            .at[flat]
+            .add(live.astype(jnp.int32), mode="drop")
+            .reshape(k, n)
+        )
+        return new_acc, cnt, cmin, cmax, cfired
+
     def _scatter_session(self, state, keys, mid_cols, live, pane, ts):
         """WindowProgram's tail-scatter, extended with per-cell min/max
         record-timestamp leaves (session boundary detection) and the
         fired flag (a cell receiving any record goes dirty, so retained
         sessions become refire-eligible)."""
+        if self._sess_fast:
+            return self._scatter_session_fast(
+                state, keys, mid_cols, live, pane, ts
+            )
         n_user = len(state["acc"])
 
         def combine_ext(a, b):
@@ -165,8 +331,15 @@ class SessionWindowProgram(WindowProgram):
         slot, pane_ids = sess_ops.ascending_slot_order(hi, ring)
 
         occ = (slot_pane[slot][None, :] == pane_ids[None, :]) & (cnt[:, slot] > 0)
-        mn = jnp.where(occ, cell_min[:, slot], TS_MAX)
-        mx = jnp.where(occ, cell_max[:, slot], W0)
+        cm, cx = cell_min[:, slot], cell_max[:, slot]
+        if self._rel_ts:
+            # pane-relative int32 storage -> absolute (pane_ids are the
+            # occupied cells' panes in this slot order)
+            base = (pane_ids * ring.pane_ms)[None, :]
+            cm = base + cm.astype(jnp.int64)
+            cx = base + cx.astype(jnp.int64)
+        mn = jnp.where(occ, cm, TS_MAX)
+        mx = jnp.where(occ, cx, W0)
         link, run_end = sess_ops.session_runs(occ, mn, mx, self.gap_ms)
         # per-run count of dirty (unfired) cells, via a segmented sum
         # along the pane axis — cheap relative to the accumulator scan,
@@ -291,6 +464,12 @@ class SessionWindowProgram(WindowProgram):
             )
             mn_q = state["cell_min"].reshape(-1)[flat]
             mx_q = state["cell_max"].reshape(-1)[flat]
+            if self._rel_ts:
+                # pane-relative int32 storage -> absolute (q is the
+                # probed pane, which IS the occupied cell's pane)
+                base = q * ring.pane_ms
+                mn_q = base + mn_q.astype(jnp.int64)
+                mx_q = base + mx_q.astype(jnp.int64)
             return occ_q & (mn_q < ts + gap) & (ts < mx_q + gap)
 
         rescued = _mergeable(pane - 1) | _mergeable(pane) | _mergeable(pane + 1)
@@ -318,7 +497,7 @@ class SessionWindowProgram(WindowProgram):
         live = live & ~uncov
         n_uncov = self._global_sum(jnp.sum(uncov).astype(jnp.int64))
 
-        init_leaves = [jnp.zeros((), dtype=a.dtype) for a in state["acc"]]
+        init_leaves = self._sess_init_leaves()
 
         def do_retarget(_):
             return sess_ops.session_retarget(
@@ -327,6 +506,16 @@ class SessionWindowProgram(WindowProgram):
                 self.gap_ms, ring, init_leaves,
                 cell_fired=state["cell_fired"],
                 lateness_ms=self.allowed_lateness_ms,
+                # pane-relative int32 boundary planes (see
+                # _analyze_session_fast): absolute base per slot + the
+                # int32 clear identities
+                ts_base=(
+                    state["slot_pane"] * ring.pane_ms
+                    if self._rel_ts
+                    else None
+                ),
+                mn_clear=self._REL_MIN_IDENT if self._rel_ts else TS_MAX,
+                mx_clear=self._REL_MAX_IDENT if self._rel_ts else W0,
             )
 
         def skip_retarget(_):
@@ -360,8 +549,10 @@ class SessionWindowProgram(WindowProgram):
         # (with lateness 0 the masks coincide and clearing wins)
         cfired = jnp.where(clear, False, cfired | mark)
         cnt = jnp.where(clear, 0, cnt)
-        cmin = jnp.where(clear, TS_MAX, cmin)
-        cmax = jnp.where(clear, W0, cmax)
+        mn_c = self._REL_MIN_IDENT if self._rel_ts else TS_MAX
+        mx_c = self._REL_MAX_IDENT if self._rel_ts else W0
+        cmin = jnp.where(clear, jnp.asarray(mn_c, cmin.dtype), cmin)
+        cmax = jnp.where(clear, jnp.asarray(mx_c, cmax.dtype), cmax)
         acc = [
             jnp.where(clear, init, a) for a, init in zip(acc, init_leaves)
         ]
