@@ -275,6 +275,40 @@ def test_session_aggregate_function():
     assert sorted(h.items) == [1, 2]
 
 
+def test_session_fast_path_matches_generic_path(monkeypatch):
+    """Direct A/B: the scatter-reduce fast path (round 5) and the
+    sorted-merge generic path must produce identical output on the same
+    randomized stream (int sums — exact either way). The oracle tests
+    cover semantics; this pins PATH equivalence, including the
+    pane-relative int32 boundary storage."""
+    from tpustream.runtime.session_program import SessionWindowProgram
+
+    rng = np.random.default_rng(21)
+    t = 0
+    recs = []
+    for _ in range(150):
+        t += int(rng.integers(0, 12_000))
+        key = str(rng.choice(["a", "b", "c", "d", "e"]))
+        jitter = int(rng.integers(0, DELAY_MS))
+        recs.append((max(0, t - jitter), key, int(rng.integers(1, 50))))
+
+    fast = run_session_job(lines_of(recs), batch_size=8)
+
+    orig = SessionWindowProgram._analyze_session_fast
+
+    def force_generic(self):
+        orig(self)
+        assert self._sess_fast, "stream/job no longer fast-eligible"
+        self._sess_fast = False
+        self._rel_ts = False
+
+    monkeypatch.setattr(
+        SessionWindowProgram, "_analyze_session_fast", force_generic
+    )
+    generic = run_session_job(lines_of(recs), batch_size=8)
+    assert fast == generic
+
+
 def test_session_aggregate_keep_first_acc_stays_on_generic_path():
     """An AGGREGATE accumulator whose merge passes a leaf through is
     keep-first semantics, not a cell-invariant key — the scatter-reduce
