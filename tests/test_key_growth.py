@@ -72,6 +72,21 @@ def test_rolling_growth():
     growth_check(build)
 
 
+def test_rolling_growth_with_parse_ahead():
+    """Growth while the parser thread runs AHEAD of the fed position
+    (parse_ahead): the thread may intern keys past the current batch,
+    so _check_capacity can grow one batch early — the migrated rows and
+    the final output must be identical to the inline path."""
+    def build(env, text):
+        return (
+            text.map(lambda l: Tuple2(l.split(" ")[1], int(l.split(" ")[2])))
+            .key_by(0)
+            .reduce(lambda a, b: Tuple2(a.f0, a.f1 + b.f1))
+        )
+
+    growth_check(build, parse_ahead=2)
+
+
 def test_eventtime_window_growth():
     """Window word planes grow: each slot's local-key run extends in
     place, mid-window accumulators intact across the rebuild."""
