@@ -48,7 +48,8 @@ jax.config.update("jax_platforms", "cpu")
 # chain fuzz, five new rescale tests, multi-host rescale restore,
 # parse_ahead/fetch_group variants, selector-guard tests) first
 # measured 28:56/244; structural cuts brought it to **23:42/225
-# measured warm** (per-tier: distributed ~3:20 in ONE worker-pair
+# measured warm; subsequent full runs of the final tree measured
+# 22:12-25:04** (per-tier: distributed ~3:20 in ONE worker-pair
 # spawn, checkpoint ~3:25, equivalence+pallas ~3:15, everything else
 # ~13:30). The round-5 cuts, in order of size: ALL multi-host variant
 # packs + the checkpoint/resume matrix merged into one worker pair
