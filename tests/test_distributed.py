@@ -16,8 +16,6 @@ import subprocess
 import sys
 import textwrap
 
-import pytest
-
 WORKER = textwrap.dedent(
     """
     import os, sys
