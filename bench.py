@@ -290,6 +290,42 @@ def full_path_ch1(rate=None, nbuf=65, warm=5, fill_ms=None,
     return _lat_result(src, env.metrics, alerts)
 
 
+def obs_snapshot_probe():
+    """Phase O: run a tiny obs-enabled chapter3 event-time job and
+    return its metrics/trace snapshot for the JSON tail.  The job is
+    deliberately small (a few dozen replayed lines, 16-row batches) —
+    this phase documents the observability surface (per-operator
+    counters, watermark-lag gauge, step spans), not a rate."""
+    from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.runtime.sources import ReplaySource
+
+    lines = [
+        f"2020-01-01T00:{m:02d}:{s:02d} ch{(m * 12 + s) % 3} 999999999"
+        for m in range(3)
+        for s in range(0, 60, 5)
+    ]
+    cfg = StreamConfig(
+        batch_size=16,
+        key_capacity=64,
+        obs=ObsConfig(enabled=True),
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    out = build(
+        env,
+        env.add_source(ReplaySource(lines)),
+        size=Time.minutes(5),
+        slide=Time.seconds(5),
+        delay=Time.minutes(1),
+    ).collect()
+    env.execute("obs-probe")
+    return env.metrics.obs_snapshot(
+        meta={"phase": "O", "lines": len(lines), "collected": len(out.items)}
+    )
+
+
 def sustainable_rate(run_paced, r0, label, rtt_ms):
     """Rate -> p99 curve with stage attribution (VERDICT r4 next #1),
     walking a descending rate ladder from the flood throughput ``r0``.
@@ -1422,6 +1458,19 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase C skipped: {e}")
 
+    # ---- Phase O: observability snapshot --------------------------------
+    obs_snap = None
+    try:
+        obs_snap = obs_snapshot_probe()
+        n_series = len(obs_snap.get("metrics", {}).get("series", []))
+        n_spans = obs_snap.get("trace", {}).get("total_spans", 0)
+        log(
+            f"phase O: obs-enabled probe job captured {n_series} metric "
+            f"series, {n_spans} step spans"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase O skipped: {e}")
+
     print(
         json.dumps(
             {
@@ -1499,6 +1548,11 @@ def main():
                     "full_path_decomposition": decomp,
                     "wire_ceiling_rows_per_s": round(wire_ceiling or 0),
                     "g1_flood_over_wire_ceiling": round(g1_over_wire or 0, 3),
+                    # phase O: per-operator counters, watermark-lag
+                    # gauge and step-span trace from an obs-enabled
+                    # probe job (docs/observability.md; render with
+                    # `python -m tpustream.obs.dump`)
+                    "obs_snapshot": obs_snap,
                 },
             }
         ),

@@ -87,3 +87,12 @@ class BoundedOutOfOrdernessTimestampExtractor(AssignerWithPeriodicWatermarks):
         if timestamp > self.current_max_timestamp:
             self.current_max_timestamp = timestamp
         return timestamp
+
+    def current_lag_ms(self) -> int:
+        """Host-side watermark lag: how far the emitted watermark trails
+        the newest observed event time (the obs layer's watermark-lag
+        gauge; Flink's ``currentOutputWatermark`` delta). Zero until a
+        watermark has actually been emitted."""
+        if self.last_emitted_watermark <= LONG_MIN:
+            return 0
+        return max(0, self.current_max_timestamp - self.last_emitted_watermark)

@@ -14,6 +14,37 @@ from typing import Optional
 
 
 @dataclass
+class ObsConfig:
+    """Observability knobs (tpustream/obs): per-operator metrics,
+    step-span tracing, gauges, and periodic snapshots.
+
+    Disabled by default: the executor then wires the null instrument
+    twins, so the per-step cost is a handful of no-op attribute calls —
+    no registry writes, no span records, no per-record work ever.
+    """
+
+    enabled: bool = False             # master switch for the obs layer
+    trace: bool = True                # record step spans (when enabled)
+    trace_ring_size: int = 4096       # retained spans (oldest overwritten)
+    profiler_bridge: bool = False     # wrap spans in
+                                      # jax.profiler.TraceAnnotation so a
+                                      # jax.profiler.trace() capture shows
+                                      # host spans aligned with device work
+    step_histogram_samples: int = 8192  # per-operator histogram ring bound
+                                        # (count/sum stay exact past it)
+    snapshot_interval_s: float = 0.0  # periodic registry+trace snapshots
+                                      # from the batch loop; 0 = only the
+                                      # on-demand Metrics.obs_snapshot()
+    snapshot_path: str = ""           # optional JSONL file the periodic
+                                      # snapshotter appends to
+
+    def replace(self, **kw) -> "ObsConfig":
+        import dataclasses
+
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass
 class StreamConfig:
     # -- batching -----------------------------------------------------------
     batch_size: int = 8192            # records per device step (static shape)
@@ -105,6 +136,11 @@ class StreamConfig:
     # G. Capped by what is actually in flight, so paced sources (which
     # drain synchronously) are unaffected. Results are byte-identical
     # either way — only wall-clock dispatch time shifts.
+    # The executor clamps the EFFECTIVE group to async_depth - 1 (at
+    # least 1): a group equal to the full in-flight window would drain
+    # the pipeline empty on every fetch, silently serializing dispatch
+    # against the round trip it was meant to amortize (ADVICE r5). Ask
+    # for a bigger group by raising async_depth alongside fetch_group.
 
     parse_ahead: int = 0
     # Source+parse pipelining depth: >0 moves the host stage (source
@@ -125,6 +161,9 @@ class StreamConfig:
     # (timestamps, epoch fields, counters), so this roughly halves H2D
     # traffic on the host link. A column whose per-batch span exceeds
     # int32 falls back to raw permanently (one recompile).
+
+    # -- observability ------------------------------------------------------
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     # -- misc ---------------------------------------------------------------
     checkpoint_dir: Optional[str] = None

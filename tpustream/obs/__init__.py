@@ -1,0 +1,47 @@
+"""Observability: hierarchical metrics registry, structured step tracing,
+and snapshot/exposition (Prometheus text + JSON).
+
+The reference tutorial has no observability beyond the print sink; a
+production Flink-class runtime ships per-operator metric groups,
+watermark-lag and backpressure gauges, and a reporter surface
+(Flink's ``MetricGroup`` / Prometheus reporter). This package provides
+the TPU-runtime equivalent:
+
+* :mod:`tpustream.obs.registry` — ``MetricsRegistry`` with
+  Counter/Gauge/Histogram instruments scoped by ``job``/``operator``/
+  ``shard`` label hierarchy.
+* :mod:`tpustream.obs.tracing` — per-step span events (parse, pack,
+  dispatch, fetch, emit) in a bounded ring buffer, optionally bridged
+  to ``jax.profiler.TraceAnnotation`` so device traces line up with the
+  host spans.
+* :mod:`tpustream.obs.snapshot` — point-in-time JSON snapshots, a
+  periodic snapshotter, and the Prometheus text renderer.
+* ``python -m tpustream.obs.dump <snapshot.json>`` — pretty-print a
+  snapshot file.
+
+Design stance: instruments update **per batch/step only** — never per
+record — and every hot-path hook has a null twin
+(:data:`tpustream.obs.registry.NULL_COUNTER`,
+:data:`tpustream.obs.tracing.NULL_TRACER`) so a job with
+``StreamConfig.obs.enabled = False`` does no observability work beyond
+a no-op attribute call per step.
+"""
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricGroup,
+    MetricsRegistry,
+    NULL_COUNTER,
+    NULL_GAUGE,
+    NULL_HISTOGRAM,
+)
+from .tracing import NULL_TRACER, StepTracer  # noqa: F401
+from .snapshot import Snapshotter, job_snapshot, write_snapshot  # noqa: F401
+from .runtime import (  # noqa: F401
+    JobObs,
+    NULL_JOB_OBS,
+    NULL_OPERATOR_OBS,
+    OperatorObs,
+)

@@ -1,0 +1,143 @@
+"""``python -m tpustream.obs.dump <snapshot.json>`` — pretty-print an
+observability snapshot file.
+
+Accepts a single-snapshot ``.json`` (from
+:func:`tpustream.obs.snapshot.write_snapshot` or the bench JSON tail's
+``obs_snapshot`` field) or a ``.jsonl`` time series (from
+:class:`~tpustream.obs.snapshot.Snapshotter`); for JSONL the last line
+is shown unless ``--index`` picks another. ``--prom`` prints the
+embedded Prometheus exposition text verbatim instead of the table view.
+
+This module deliberately imports nothing beyond the stdlib — no jax, no
+``tpustream.runtime`` — so ``render``/``main`` are importable and
+testable without a device runtime (running it as ``-m`` still executes
+the ``tpustream`` package root, which does import jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path: str, index: int) -> dict:
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if not stripped:
+        raise SystemExit(f"{path}: empty file")
+    if "\n" in text.strip() and stripped[0] == "{" and _looks_jsonl(text):
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        return json.loads(lines[index])
+    doc = json.loads(text)
+    # Allow pointing at a whole bench JSON tail; descend to its snapshot.
+    if "metrics" not in doc and "obs_snapshot" in doc:
+        return doc["obs_snapshot"]
+    if "metrics" not in doc and "obs_snapshot" in doc.get("detail", {}):
+        return doc["detail"]["obs_snapshot"]
+    return doc
+
+
+def _looks_jsonl(text: str) -> bool:
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if len(lines) < 2:
+        return False
+    try:
+        json.loads(lines[0])
+        json.loads(lines[1])
+        return True
+    except ValueError:
+        return False
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return "-"
+    return ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render(snap: dict) -> str:
+    out = []
+    meta = snap.get("meta", {})
+    if meta:
+        out.append("meta: " + ", ".join(f"{k}={meta[k]}" for k in sorted(meta)))
+    series = snap.get("metrics", {}).get("series", [])
+    scalars = [s for s in series if s["type"] in ("counter", "gauge")]
+    hists = [s for s in series if s["type"] == "histogram"]
+    if scalars:
+        out.append("")
+        out.append(f"{'NAME':<32} {'TYPE':<8} {'VALUE':>14}  LABELS")
+        for s in scalars:
+            out.append(
+                f"{s['name']:<32} {s['type']:<8} {_fmt_val(s['value']):>14}  "
+                f"{_fmt_labels(s['labels'])}"
+            )
+    if hists:
+        out.append("")
+        out.append(
+            f"{'HISTOGRAM':<32} {'COUNT':>8} {'SUM':>12} {'P50':>10} "
+            f"{'P90':>10} {'P99':>10}  LABELS"
+        )
+        for s in hists:
+            v = s["value"]
+            out.append(
+                f"{s['name']:<32} {v['count']:>8} {_fmt_val(v['sum']):>12} "
+                f"{_fmt_val(v['p50']):>10} {_fmt_val(v['p90']):>10} "
+                f"{_fmt_val(v['p99']):>10}  {_fmt_labels(s['labels'])}"
+            )
+    trace = snap.get("trace")
+    if trace:
+        out.append("")
+        out.append(
+            f"trace: {trace['total_spans']} spans total, "
+            f"{len(trace.get('events', []))} retained "
+            f"(capacity {trace['capacity']}, dropped {trace['dropped_spans']})"
+        )
+        by_kind = {}
+        for ev in trace.get("events", []):
+            agg = by_kind.setdefault(ev["kind"], [0, 0.0])
+            agg[0] += 1
+            agg[1] += ev["dur_s"]
+        for kind in sorted(by_kind):
+            n, tot = by_kind[kind]
+            out.append(
+                f"  {kind:<10} n={n:<6} total={tot:.6f}s mean={tot / n:.6f}s"
+            )
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpustream.obs.dump",
+        description="Pretty-print a tpustream observability snapshot.",
+    )
+    ap.add_argument("path", help="snapshot .json, Snapshotter .jsonl, or bench JSON tail")
+    ap.add_argument(
+        "--index",
+        type=int,
+        default=-1,
+        help="which snapshot to show from a .jsonl time series (default: last)",
+    )
+    ap.add_argument(
+        "--prom",
+        action="store_true",
+        help="print the embedded Prometheus exposition text instead",
+    )
+    args = ap.parse_args(argv)
+    snap = _load(args.path, args.index)
+    if args.prom:
+        sys.stdout.write(snap.get("prometheus", ""))
+    else:
+        sys.stdout.write(render(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,327 @@
+"""Hierarchical metrics registry: Counter/Gauge/Histogram instruments
+scoped by label hierarchy (``job`` -> ``operator`` -> ``shard``).
+
+Mirrors Flink's ``MetricGroup`` tree flattened into Prometheus-style
+label sets: every instrument is one *series* identified by
+``(name, sorted labels)``, and a :class:`MetricGroup` is just a label
+context that mints instruments against the shared registry. Series are
+created once (idempotent lookup) and updated lock-free from the single
+executor thread; the only cross-thread readers are snapshot/exposition,
+which tolerate a torn read of one sample (values are monotone counters
+or last-write-wins gauges).
+
+Instruments update per batch/step — the registry is never consulted on
+a per-record path. The ``NULL_*`` singletons are the disabled twins:
+same method surface, no state, no work.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Tuple
+
+PROM_PREFIX = "tpustream_"
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotone (from the instrument's view) int counter.
+
+    ``set_total`` exists for the ``Metrics`` facade, whose legacy
+    attribute assignment (``metrics.records_in += n`` and checkpoint
+    baseline folding via ``setattr``) writes absolute totals.
+    """
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "_value")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    def set_total(self, v: int) -> None:
+        self._value = int(v)
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def snapshot_value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar; ``set_fn`` installs a pull callback
+    evaluated at snapshot time (queue depths, live state reads) so the
+    hot path never pays for it."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "_value", "_fn")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._value: float = 0.0
+        self._fn: Optional[Callable[[], Optional[float]]] = None
+
+    def set(self, v) -> None:
+        self._value = v
+
+    def set_fn(self, fn: Callable[[], Optional[float]]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                v = self._fn()
+            except Exception:
+                v = None
+            if v is not None:
+                self._value = v
+        return self._value
+
+    def snapshot_value(self):
+        return self.value
+
+
+class Histogram:
+    """Sample-holding histogram with exact running count/sum.
+
+    ``max_samples = 0`` keeps every observation (exact percentiles — the
+    per-job latency/time series the summary facade needs stay exact);
+    ``> 0`` keeps the most recent ``max_samples`` observations in a ring
+    (bounded memory for long-running per-operator series) while
+    ``count``/``sum`` stay exact.
+    """
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "max_samples", "_ring", "_pos", "count", "sum")
+
+    def __init__(self, name: str, labels: Dict[str, str], max_samples: int = 0):
+        self.name = name
+        self.labels = dict(labels)
+        self.max_samples = int(max_samples)
+        self._ring: List[float] = []
+        self._pos = 0  # next overwrite slot when the ring is full
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if self.max_samples and len(self._ring) >= self.max_samples:
+            self._ring[self._pos] = v
+            self._pos = (self._pos + 1) % self.max_samples
+        else:
+            self._ring.append(v)
+
+    def observe_many(self, vs) -> None:
+        for v in vs:
+            self.observe(v)
+
+    @property
+    def samples(self) -> List[float]:
+        return list(self._ring)
+
+    def percentile(self, q: float) -> float:
+        """``q`` in [0, 100]; linear interpolation between closest ranks
+        (numpy's default ``np.percentile`` method) over the retained
+        samples."""
+        vals = sorted(self._ring)
+        if not vals:
+            return 0.0
+        rank = (len(vals) - 1) * (q / 100.0)
+        lo = math.floor(rank)
+        hi = math.ceil(rank)
+        if lo == hi:
+            return vals[lo]
+        frac = rank - lo
+        return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+    def snapshot_value(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class _NullInstrument:
+    """Disabled twin of every instrument: full method surface, no work.
+
+    One shared instance backs every hook when ``ObsConfig.enabled`` is
+    False, so the per-step cost of disabled observability is a no-op
+    method call."""
+
+    kind = "null"
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v) -> None:
+        pass
+
+    def set_fn(self, fn) -> None:
+        pass
+
+    def set_total(self, v) -> None:
+        pass
+
+    def observe(self, v) -> None:
+        pass
+
+    def observe_many(self, vs) -> None:
+        pass
+
+    value = 0
+    count = 0
+    sum = 0.0
+
+    @property
+    def samples(self) -> list:
+        return []
+
+    def percentile(self, q: float) -> float:
+        return 0.0
+
+
+NULL_COUNTER = _NullInstrument()
+NULL_GAUGE = NULL_COUNTER
+NULL_HISTOGRAM = NULL_COUNTER
+
+
+class MetricGroup:
+    """A label scope: ``registry.group(job=...)``,
+    ``group.group(operator=...)`` etc. Instrument calls mint (or fetch)
+    the series named by this scope's merged labels."""
+
+    def __init__(self, registry: "MetricsRegistry", labels: Dict[str, str]):
+        self.registry = registry
+        self.labels = dict(labels)
+
+    def group(self, **labels) -> "MetricGroup":
+        merged = dict(self.labels)
+        merged.update({k: str(v) for k, v in labels.items()})
+        return MetricGroup(self.registry, merged)
+
+    def counter(self, name: str) -> Counter:
+        return self.registry._series(Counter, name, self.labels)
+
+    def gauge(self, name: str) -> Gauge:
+        return self.registry._series(Gauge, name, self.labels)
+
+    def histogram(self, name: str, max_samples: int = 0) -> Histogram:
+        return self.registry._series(
+            Histogram, name, self.labels, max_samples=max_samples
+        )
+
+
+class MetricsRegistry:
+    """Flat series store behind the MetricGroup hierarchy."""
+
+    def __init__(self):
+        self._by_key: Dict[Tuple[str, LabelKey], object] = {}
+
+    def group(self, **labels) -> MetricGroup:
+        return MetricGroup(self, {k: str(v) for k, v in labels.items()})
+
+    def _series(self, cls, name: str, labels: Dict[str, str], **kw):
+        key = (name, _label_key(labels))
+        inst = self._by_key.get(key)
+        if inst is None:
+            inst = cls(name, labels, **kw)
+            self._by_key[key] = inst
+        elif not isinstance(inst, cls):
+            raise TypeError(
+                f"metric series {name!r} {labels!r} already registered as "
+                f"{type(inst).__name__}, requested {cls.__name__}"
+            )
+        return inst
+
+    def series(self) -> List[object]:
+        return [self._by_key[k] for k in sorted(self._by_key)]
+
+    # -- exposition --------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-serializable point-in-time view of every series."""
+        out = []
+        for inst in self.series():
+            out.append(
+                {
+                    "name": inst.name,
+                    "type": inst.kind,
+                    "labels": dict(inst.labels),
+                    "value": inst.snapshot_value(),
+                }
+            )
+        return {"series": out}
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition (0.0.4). Counters/gauges render
+        directly; histograms render as summaries (quantile series plus
+        ``_sum``/``_count``), the convention Flink's Prometheus reporter
+        uses for its latency histograms."""
+        by_name: Dict[str, List[object]] = {}
+        for inst in self.series():
+            by_name.setdefault(inst.name, []).append(inst)
+        lines: List[str] = []
+        for name in sorted(by_name):
+            insts = by_name[name]
+            kind = insts[0].kind
+            prom = PROM_PREFIX + name
+            if kind == "histogram":
+                lines.append(f"# TYPE {prom} summary")
+                for h in insts:
+                    for q, qv in (("0.5", 50), ("0.9", 90), ("0.99", 99)):
+                        lbl = _prom_labels(h.labels, quantile=q)
+                        lines.append(f"{prom}{lbl} {_prom_num(h.percentile(qv))}")
+                    lbl = _prom_labels(h.labels)
+                    lines.append(f"{prom}_sum{lbl} {_prom_num(h.sum)}")
+                    lines.append(f"{prom}_count{lbl} {h.count}")
+            else:
+                lines.append(f"# TYPE {prom} {kind}")
+                for inst in insts:
+                    lbl = _prom_labels(inst.labels)
+                    lines.append(f"{prom}{lbl} {_prom_num(inst.snapshot_value())}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prom_num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: Dict[str, str], **extra) -> str:
+    merged = dict(labels)
+    merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(
+        f'{k}="{_prom_escape(str(merged[k]))}"' for k in sorted(merged)
+    )
+    return "{" + body + "}"

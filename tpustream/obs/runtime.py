@@ -1,0 +1,185 @@
+"""Runtime-facing observability bundles.
+
+The executor talks to observability exclusively through two small
+objects — :class:`JobObs` (one per job: shared registry, tracer,
+snapshotter, job-scope gauges) and :class:`OperatorObs` (one per runner:
+the operator-labelled counters/histograms/gauges plus span minting).
+Both have null twins with the identical surface, installed when
+``StreamConfig.obs.enabled`` is False, so every hot-path call site is an
+unconditional attribute call with no ``if obs:`` branches.
+
+Naming scheme (see docs/observability.md): job-scope series carry a
+``job`` label; operator-scope series add ``operator`` (and optionally
+``shard``) and an ``operator_`` name prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import MetricsRegistry, NULL_COUNTER, NULL_GAUGE, NULL_HISTOGRAM
+from .snapshot import Snapshotter, job_snapshot
+from .tracing import NULL_TRACER, StepTracer
+
+
+class OperatorObs:
+    """Per-operator instrument bundle, minted by :meth:`JobObs.operator`."""
+
+    enabled = True
+
+    def __init__(self, group, tracer, hist_samples: int = 8192):
+        self._group = group
+        self.tracer = tracer
+        self.name = group.labels.get("operator", "")
+        self._hist_samples = int(hist_samples)
+        self.records_in = group.counter("operator_records_in")
+        self.records_emitted = group.counter("operator_records_emitted")
+        self.steps = group.counter("operator_steps")
+        # async enqueue time (the _run_step stopwatch) vs the blocking
+        # fetch wait (_finish_group, divided per step) — together they
+        # are the job-level step_times_s series, split by phase here
+        self.dispatch_time_s = group.histogram(
+            "operator_dispatch_time_s", max_samples=self._hist_samples
+        )
+        self.step_time_s = group.histogram(
+            "operator_step_time_s", max_samples=self._hist_samples
+        )
+        self.inflight = group.gauge("operator_inflight_steps")
+
+    def shard(self, index) -> "OperatorObs":
+        """Same operator, one mesh shard: adds the ``shard`` label."""
+        return OperatorObs(
+            self._group.group(shard=str(index)), self.tracer, self._hist_samples
+        )
+
+    def counter(self, name: str):
+        return self._group.counter("operator_" + name)
+
+    def gauge(self, name: str):
+        return self._group.gauge("operator_" + name)
+
+    def histogram(self, name: str):
+        return self._group.histogram(
+            "operator_" + name, max_samples=self._hist_samples
+        )
+
+    def span(self, kind: str, step: int = -1):
+        return self.tracer.span(kind, step, self.name)
+
+
+class JobObs:
+    """Per-job observability root: one registry + tracer + snapshotter
+    shared by the Metrics facade and every runner's OperatorObs."""
+
+    enabled = True
+
+    def __init__(self, obs_cfg=None, job_name: str = "job",
+                 registry: Optional[MetricsRegistry] = None):
+        cfg = obs_cfg
+        trace = getattr(cfg, "trace", True)
+        ring = getattr(cfg, "trace_ring_size", 4096)
+        bridge = getattr(cfg, "profiler_bridge", False)
+        self.hist_samples = getattr(cfg, "step_histogram_samples", 8192)
+        self.registry = registry or MetricsRegistry()
+        self.job_name = str(job_name)
+        self.group = self.registry.group(job=self.job_name)
+        self.tracer = StepTracer(ring, bridge) if trace else NULL_TRACER
+        self.snapshotter = Snapshotter(
+            self.registry,
+            self.tracer,
+            interval_s=getattr(cfg, "snapshot_interval_s", 0.0),
+            jsonl_path=getattr(cfg, "snapshot_path", "") or None,
+            meta={"job": self.job_name},
+        )
+        self._op_names: dict = {}
+
+    def operator(self, name: str) -> OperatorObs:
+        """Mint the operator scope for one runner. Chained stages that
+        share a program kind get de-aliased names (``window``,
+        ``window_2``, ...) so their series never merge."""
+        n = self._op_names.get(name, 0)
+        self._op_names[name] = n + 1
+        label = name if n == 0 else f"{name}_{n + 1}"
+        return OperatorObs(
+            self.group.group(operator=label), self.tracer, self.hist_samples
+        )
+
+    def counter(self, name: str):
+        return self.group.counter(name)
+
+    def gauge(self, name: str):
+        return self.group.gauge(name)
+
+    def maybe_snapshot(self):
+        return self.snapshotter.maybe_snapshot()
+
+    def snapshot(self, meta: Optional[dict] = None) -> dict:
+        m = {"job": self.job_name}
+        m.update(meta or {})
+        return job_snapshot(self.registry, self.tracer, meta=m)
+
+    def to_prometheus_text(self) -> str:
+        return self.registry.to_prometheus_text()
+
+
+class _NullOperatorObs:
+    enabled = False
+    name = ""
+    tracer = NULL_TRACER
+    records_in = NULL_COUNTER
+    records_emitted = NULL_COUNTER
+    steps = NULL_COUNTER
+    dispatch_time_s = NULL_HISTOGRAM
+    step_time_s = NULL_HISTOGRAM
+    inflight = NULL_GAUGE
+
+    __slots__ = ()
+
+    def shard(self, index):
+        return self
+
+    def counter(self, name: str):
+        return NULL_COUNTER
+
+    def gauge(self, name: str):
+        return NULL_GAUGE
+
+    def histogram(self, name: str):
+        return NULL_HISTOGRAM
+
+    def span(self, kind: str, step: int = -1):
+        return NULL_TRACER.span(kind, step)
+
+
+NULL_OPERATOR_OBS = _NullOperatorObs()
+
+
+class _NullJobObs:
+    enabled = False
+    registry = None
+    tracer = NULL_TRACER
+    job_name = ""
+    snapshotter = None
+
+    __slots__ = ()
+
+    def operator(self, name: str):
+        return NULL_OPERATOR_OBS
+
+    def counter(self, name: str):
+        return NULL_COUNTER
+
+    def gauge(self, name: str):
+        return NULL_GAUGE
+
+    def maybe_snapshot(self):
+        return None
+
+    def snapshot(self, meta: Optional[dict] = None) -> dict:
+        return {"version": 0, "meta": dict(meta or {}), "metrics": {"series": []}}
+
+    def to_prometheus_text(self) -> str:
+        return ""
+
+
+NULL_JOB_OBS = _NullJobObs()

@@ -1,0 +1,102 @@
+"""Point-in-time observability snapshots and the periodic snapshotter.
+
+A *snapshot* is one JSON-serializable dict bundling the registry's
+series, the tracer's retained spans, and both exposition forms' inputs
+(the Prometheus text itself is included so a snapshot file is
+self-contained for scraping replay or the ``tpustream.obs.dump`` CLI).
+
+:class:`Snapshotter` gives the executor a cheap "is it time yet" check
+— one ``perf_counter`` compare per batch — and appends periodic
+snapshots to a bounded in-memory list (and optionally a JSONL file).
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+SNAPSHOT_VERSION = 1
+
+
+def job_snapshot(registry, tracer=None, meta: Optional[dict] = None) -> dict:
+    """Bundle ``registry`` (a :class:`~tpustream.obs.registry.MetricsRegistry`)
+    and optional ``tracer`` into one serializable dict."""
+    snap = {
+        "version": SNAPSHOT_VERSION,
+        "meta": dict(meta or {}),
+        "metrics": registry.snapshot(),
+        "prometheus": registry.to_prometheus_text(),
+    }
+    if tracer is not None:
+        snap["trace"] = tracer.snapshot()
+    return snap
+
+
+def write_snapshot(path: str, snap: dict) -> str:
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+class Snapshotter:
+    """Periodic snapshot taker driven from the executor's batch loop.
+
+    ``maybe_snapshot()`` is the per-batch hook: it no-ops until
+    ``interval_s`` has elapsed since the last capture, then records a
+    snapshot. Retains at most ``max_snapshots`` (oldest dropped); when
+    ``jsonl_path`` is set every snapshot is also appended there, one
+    JSON object per line, so long jobs keep a full on-disk time series
+    regardless of the in-memory bound.
+    """
+
+    def __init__(
+        self,
+        registry,
+        tracer=None,
+        interval_s: float = 0.0,
+        max_snapshots: int = 64,
+        jsonl_path: Optional[str] = None,
+        meta: Optional[dict] = None,
+    ):
+        self.registry = registry
+        self.tracer = tracer
+        self.interval_s = float(interval_s)
+        self.max_snapshots = max(1, int(max_snapshots))
+        self.jsonl_path = jsonl_path
+        self.meta = dict(meta or {})
+        self.snapshots: List[dict] = []
+        self._last = time.perf_counter()
+        self._t0 = self._last
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval_s > 0.0
+
+    def maybe_snapshot(self) -> Optional[dict]:
+        if not self.enabled:
+            return None
+        now = time.perf_counter()
+        if now - self._last < self.interval_s:
+            return None
+        self._last = now
+        return self.take(at_s=now - self._t0)
+
+    def take(self, at_s: Optional[float] = None) -> dict:
+        meta = dict(self.meta)
+        if at_s is None:
+            at_s = time.perf_counter() - self._t0
+        meta["at_s"] = round(at_s, 6)
+        snap = job_snapshot(self.registry, self.tracer, meta=meta)
+        self.snapshots.append(snap)
+        if len(self.snapshots) > self.max_snapshots:
+            del self.snapshots[0 : len(self.snapshots) - self.max_snapshots]
+        if self.jsonl_path:
+            try:
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(snap, sort_keys=True) + "\n")
+            except OSError:
+                pass
+        return snap

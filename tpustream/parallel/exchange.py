@@ -10,12 +10,30 @@ is counted, never silently dropped (SURVEY.md §2.3 "hash keys host-side
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import math
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .mesh import AXIS
+
+
+def exchange_capacity(
+    batch_size: int, n_shards: int, capacity_factor: Optional[float] = None
+) -> int:
+    """Per-destination send-buffer rows for the keyBy all_to_all.
+
+    ``None`` factor sizes the buffer for the loss-free worst case (every
+    local record to one destination: the full local batch); a factor
+    shrinks it toward the uniform-keys expectation ``local_b / shards``,
+    trading memory for counted overflow. Single shared definition so the
+    sharded programs and the obs gauges report the same number.
+    """
+    local_b = batch_size // n_shards
+    if capacity_factor is None:
+        return local_b
+    return min(local_b, max(1, math.ceil(local_b / n_shards * capacity_factor)))
 
 
 def exchange_by_key(

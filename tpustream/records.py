@@ -120,7 +120,18 @@ class DerivedKeyTable(StringTable):
         return self._originals[i]
 
     def state_dict(self) -> dict:
-        return {"strings": list(self._to_str), "originals": list(self._originals)}
+        # capture-then-truncate: the parse-ahead thread may be interning
+        # while a checkpoint snapshots this table. intern_value appends to
+        # _to_str (via intern) BEFORE _originals, so at every instant
+        # len(_to_str) >= len(_originals) and the first len(_originals)
+        # entries of both lists are final. Copying _originals FIRST and
+        # truncating the _to_str copy to its length therefore yields a
+        # consistent prefix snapshot without a lock; copying in the other
+        # order could pair a new string with a missing original (a torn
+        # table that restores with misaligned key ids).
+        originals = list(self._originals)
+        strings = list(self._to_str)[: len(originals)]
+        return {"strings": strings, "originals": originals}
 
     def load_state_dict(self, state: dict) -> None:
         super().load_state_dict(state)

@@ -45,6 +45,7 @@ class CountWindowProgram(WindowProgram):
     accepted_kinds = ("count",)
     fires_on_clock = False
     main_emission_prefix = False  # emissions ride the sorted batch order
+    operator_name = "count_window"
 
     def __init__(self, plan: JobPlan, cfg):
         BaseProgram.__init__(self, plan, cfg)
@@ -258,6 +259,8 @@ class SlidingCountWindowProgram(_ElementLogMixin, CountWindowProgram):
     prefer tumbling counts when windows don't overlap.
     """
 
+    operator_name = "sliding_count_window"
+
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
         self.count_slide = int(plan.stateful.window.count_slide)
@@ -356,6 +359,8 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
     element matrices), so the executor needs no state synchronization
     and emission pipelining stays on.
     """
+
+    operator_name = "count_process"
 
     def _build_agg(self):
         # no incremental aggregation: the "accumulator" is the raw record

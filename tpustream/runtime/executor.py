@@ -383,6 +383,11 @@ class Runner:
         self.metrics = metrics
         self.program = build_program(plan, cfg)
         self._inner_step = self.program.jitted_step()
+        # per-operator observability scope: counters/histograms labelled
+        # {job, operator} plus span minting. The null twin (obs disabled)
+        # makes every obs call below a no-op attribute call.
+        self.obs = metrics.job_obs.operator(self.program.operator_name)
+        self._step_idx = 0
         # H2D transfer compression: int64 columns and timestamps ship as
         # int32 deltas against a per-batch base scalar (lossless) and
         # re-expand on device — on the PCIe/host link these columns are
@@ -469,6 +474,26 @@ class Runner:
         # subtracts them so a resumed run reports since-resume numbers
         # and strict_overflow never fails on pre-snapshot loss)
         self._counter_baseline: Dict[str, int] = {}
+        if self.obs.enabled:
+            # pull-style backpressure gauge: chain hand-off rows parked
+            # between pumps, read only at snapshot time
+            self.obs.gauge("chain_buffer_entries").set_fn(
+                lambda: len(self._chain_buf) + len(self._chain_rows)
+            )
+            if self.program.n_shards > 1:
+                from ..parallel.exchange import exchange_capacity
+
+                self.obs.gauge("exchange_capacity_rows").set(
+                    exchange_capacity(
+                        cfg.batch_size,
+                        self.program.n_shards,
+                        cfg.exchange_capacity_factor,
+                    )
+                )
+            for i, (_, sink) in enumerate(self.sinks):
+                sink.obs_counter = self.obs.counter(f"sink{i}_emitted")
+            for tag, (_, sink) in self.side_sinks.items():
+                sink.obs_counter = self.obs.counter(f"side_sink{tag}_emitted")
 
     _COUNTER_NAMES = (
         "window_fires", "late_dropped", "alert_overflow",
@@ -732,12 +757,14 @@ class Runner:
                 valid=batch.valid[start : start + cfg.batch_size],
             )
             padded = sub.pad_to(cfg.batch_size)
-            inputs = self._device_inputs(
-                padded, self.plan.time_characteristic
-            )
+            with self.obs.span("pack", self._step_idx + 1):
+                inputs = self._device_inputs(
+                    padded, self.plan.time_characteristic
+                )
             self._run_step(inputs, wm_lower, t_batch)
             if self.count_input:
                 self.metrics.records_in += int(sub.n)
+                self.obs.records_in.inc(int(sub.n))
             # with a max_fires_per_step budget, drain deferred window ends
             # BEFORE the next batch can advance the pane ring past them —
             # each drain step still fires at most `budget` ends, so the
@@ -811,15 +838,20 @@ class Runner:
             packed = tuple(self._gshard(p) for p in packed)
             valid = self._gshard(valid)
             ts_p = self._gshard(ts_p)
-        with Stopwatch() as sw:
-            self.state, emissions, counts = self.step(
-                self.state, packed, bases, valid, ts_p, ts_b,
-                jnp.asarray(wm_lower, jnp.int64),
-            )
-            for leaf in counts.values():
-                leaf.copy_to_host_async()
+        self._step_idx += 1
+        with self.obs.span("dispatch", self._step_idx):
+            with Stopwatch() as sw:
+                self.state, emissions, counts = self.step(
+                    self.state, packed, bases, valid, ts_p, ts_b,
+                    jnp.asarray(wm_lower, jnp.int64),
+                )
+                for leaf in counts.values():
+                    leaf.copy_to_host_async()
         self.metrics.step_times_s.append(sw.elapsed)
+        self.obs.steps.inc()
+        self.obs.dispatch_time_s.observe(sw.elapsed)
         self._inflight.append((emissions, counts, t_batch))
+        self.obs.inflight.set(len(self._inflight))
         while len(self._inflight) > self._max_inflight:
             g = self._fetch_group
             self._finish_group(self._inflight[:g])
@@ -830,10 +862,16 @@ class Runner:
         """Steps whose count scalars fetch in one device_get round trip
         (StreamConfig.fetch_group; >1 amortizes a high-latency link's
         RTT). Multi-host keeps the per-step cadence: the fetch decision
-        drives collective-bearing paths and must stay step-aligned."""
+        drives collective-bearing paths and must stay step-aligned.
+
+        Clamped to the in-flight window minus one (= async_depth - 1,
+        at least 1): a group covering the FULL window would drain the
+        pipeline empty on every fetch — no step left in flight to
+        overlap the next round trip — silently serializing the very
+        path fetch_group exists to pipeline (ADVICE r5)."""
         if self._multiproc:
             return 1
-        return max(1, self.cfg.fetch_group)
+        return max(1, min(self.cfg.fetch_group, max(1, self._max_inflight)))
 
     def drain_inflight(self):
         """Dispatch every pending step's emissions (checkpoint barrier /
@@ -1178,7 +1216,7 @@ class Runner:
         # round trip however many steps the group covers), then all
         # still-needed emission streams fetch in a second one; dispatch
         # order is unchanged.
-        with Stopwatch() as sw:
+        with self.obs.span("fetch", self._step_idx), Stopwatch() as sw:
             spec, spec_rows = self._speculative_main(entries)
             if spec is not None:
                 cnts0, spec_fetched = jax.device_get(
@@ -1212,7 +1250,13 @@ class Runner:
                 ]
             else:
                 fetched_list = jax.device_get(fetches)
-        self.metrics.step_times_s.append(sw.elapsed)
+        # one sample PER STEP, not per fetch group: the group's blocking
+        # wait divides evenly across its entries, so the histogram's
+        # percentiles stay comparable across fetch_group settings while
+        # the sum (summary()'s device_time_s) is unchanged (ADVICE r5)
+        per_entry = sw.elapsed / len(entries)
+        self.metrics.step_times_s.extend([per_entry] * len(entries))
+        self.obs.step_time_s.observe_many([per_entry] * len(entries))
         for (entry, pre, fetched) in zip(entries, pre_fetched, fetched_list):
             fetched.update(pre)
             self._dispatch(fetched, entry[2])
@@ -1225,14 +1269,35 @@ class Runner:
         present = {
             n: self.state[n] for n in self._COUNTER_NAMES if n in self.state
         }
-        if not present:
+        if present:
+            vals = jax.device_get(present)
+            for n, val in vals.items():
+                # window_fires for the host-evaluated process path is
+                # counted host-side; device programs count on device —
+                # += merges both
+                delta = int(val) - self._counter_baseline.get(n, 0)
+                setattr(self.metrics, n, getattr(self.metrics, n) + delta)
+                if delta:
+                    self.obs.counter(n).inc(delta)
+        if self.obs.enabled:
+            self._finalize_obs_gauges()
+
+    def _finalize_obs_gauges(self):
+        """Expose the device-authoritative scalar clocks as gauges: the
+        event-time watermark, newest seen timestamp, and deferred-fire
+        backlog. One extra device_get per job, obs-enabled runs only."""
+        scalars = self.program.obs_state_scalars(self.state)
+        if not scalars:
             return
-        vals = jax.device_get(present)
-        for n, val in vals.items():
-            # window_fires for the host-evaluated process path is counted
-            # host-side; device programs count on device — += merges both
-            delta = int(val) - self._counter_baseline.get(n, 0)
-            setattr(self.metrics, n, getattr(self.metrics, n) + delta)
+        vals = jax.device_get(scalars)
+        for n, v in vals.items():
+            self.obs.gauge("state_" + n).set(int(v))
+        wm, max_ts = vals.get("wm"), vals.get("max_ts")
+        if wm is not None and max_ts is not None and int(wm) > LONG_MIN:
+            # 0 after the end-of-stream MAX watermark; the live lag
+            # signal during a run is the job-scope host gauge fed from
+            # the timestamp assigner (execute_job)
+            self.obs.gauge("watermark_lag").set(max(0, int(max_ts) - int(wm)))
 
     def check_strict(self):
         """strict_overflow: fail loudly if any lossy counter is nonzero
@@ -1295,6 +1360,10 @@ class Runner:
                 sink.emit(item, subtask=subtask)
 
     def _dispatch(self, emissions, t_batch=None):
+        with self.obs.span("emit", self._step_idx):
+            self._dispatch_inner(emissions, t_batch)
+
+    def _dispatch_inner(self, emissions, t_batch=None):
         # step epoch for host-evaluated fire ordering: the per-step
         # dispatch sequence is SPMD-identical across processes (the
         # fetch decision keys on GLOBAL emission counts), so it is a
@@ -1310,6 +1379,8 @@ class Runner:
             if not chained:
                 self.metrics.records_emitted += n
             self.metrics.window_fires += fired
+            if fired:
+                self.obs.counter("window_fires").inc(fired)
         main = emissions.get("main")
         if main is not None:
             mask = np.asarray(main["mask"])
@@ -1398,7 +1469,10 @@ class Runner:
         late = emissions.get("late")
         if late is not None and self.side_sinks:
             self._dispatch_late(late)
-        if t_batch is not None and self.metrics.records_emitted > emitted_before:
+        emitted_delta = self.metrics.records_emitted - emitted_before
+        if emitted_delta:
+            self.obs.records_emitted.inc(emitted_delta)
+        if t_batch is not None and emitted_delta:
             self.metrics.emit_latencies_s.append(
                 time.perf_counter() - t_batch
             )
@@ -1539,15 +1613,19 @@ def _make_runner_chain(plans, cfg, metrics, lazy_schemas=None) -> Runner:
     return runner
 
 
-def _prefetch_iter(it, depth: int):
+def _prefetch_iter(it, depth: int, depth_gauge=None):
     """Drain ``it`` on a daemon thread into a bounded queue (size =
     ``depth``): the producer blocks when the consumer falls behind
     (bounded memory, natural backpressure), and producer exceptions
-    re-raise at the consumer. Used for StreamConfig.parse_ahead."""
+    re-raise at the consumer. Used for StreamConfig.parse_ahead.
+    ``depth_gauge`` (obs) reads the queue depth at snapshot time — a
+    full queue means the device loop, not the parser, is the bottleneck."""
     import queue as queue_mod
     import threading
 
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(1, depth))
+    if depth_gauge is not None:
+        depth_gauge.set_fn(q.qsize)
     stop = threading.Event()
 
     def put(item) -> bool:
@@ -1591,7 +1669,33 @@ def execute_job(env, sink_nodes) -> JobResult:
     plan = plans[0]
     chained = len(plans) > 1
     host = HostStage(plan, cfg)
-    metrics = Metrics()
+    if cfg.obs.enabled:
+        from ..obs.runtime import JobObs
+
+        job_obs = JobObs(cfg.obs, job_name=env.job_name or "job")
+        metrics = Metrics(registry=job_obs.registry, job_name=job_obs.job_name)
+        metrics.job_obs = job_obs
+    else:
+        metrics = Metrics()
+        job_obs = metrics.job_obs  # the null twin
+    # host-side watermark gauges: fed per batch from the job's periodic
+    # timestamp assigner (Flink's currentInputWatermark / watermark-lag
+    # operator metrics). The device carries the authoritative clock; this
+    # mirrors the host bookkeeping the reference documents, and stays
+    # nonzero DURING the run (the device copy reads 0 lag after the
+    # end-of-stream MAX watermark).
+    assigner = plan.ts_assigner
+    wm_gauge = lag_gauge = None
+    if (
+        job_obs.enabled
+        and assigner is not None
+        and hasattr(assigner, "observe")
+        and hasattr(assigner, "get_current_watermark")
+    ):
+        wm_gauge = job_obs.gauge("watermark_ms")
+        lag_gauge = job_obs.gauge("watermark_lag_ms")
+    if job_obs.enabled:
+        job_obs.gauge("source_queue_depth").set_fn(plan.source.queue_depth)
     runner: Optional[Runner] = None
     proc_now = 0
     domain = plan.time_characteristic
@@ -1680,7 +1784,9 @@ def execute_job(env, sink_nodes) -> JobResult:
                 )
             skip_state[0] -= take
         batch = wm_hint = None
-        with Stopwatch() as hw:
+        # parse spans may record from the parse-ahead thread; the
+        # tracer's ring append is GIL-safe for this single extra writer
+        with job_obs.tracer.span("parse"), Stopwatch() as hw:
             if sb.raw is not None:
                 batch, wm_hint = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
                 if batch is None and sb.n_raw:
@@ -1705,7 +1811,15 @@ def execute_job(env, sink_nodes) -> JobResult:
     if prefetched:
         # source + parse on their own thread (the reference's source-
         # operator thread): batch N+1 parses while N crosses the link
-        prepared = _prefetch_iter(prepared, cfg.parse_ahead)
+        prepared = _prefetch_iter(
+            prepared,
+            cfg.parse_ahead,
+            depth_gauge=(
+                job_obs.gauge("parse_ahead_queue_depth")
+                if job_obs.enabled
+                else None
+            ),
+        )
 
     for sb, batch, wm_hint, hw in prepared:
         # idle reference: inline, parse START (hw.t0) — the wait inside
@@ -1719,6 +1833,16 @@ def execute_job(env, sink_nodes) -> JobResult:
         lines_consumed += sb.n_records
         metrics.host_times_s.append(hw.elapsed)
         metrics.batches += 1
+        if lag_gauge is not None and batch is not None and batch.ts is not None \
+                and batch.ts.size:
+            # per-batch host watermark bookkeeping (obs-gated): observe
+            # the batch max, then read the monotone watermark + its lag
+            assigner.observe(int(batch.ts.max()))
+            wm_gauge.set(assigner.get_current_watermark().timestamp)
+            lag = getattr(assigner, "current_lag_ms", None)
+            if lag is not None:
+                lag_gauge.set(lag())
+        job_obs.maybe_snapshot()
         if sb.proc_ts.size:
             proc_now = max(proc_now, int(sb.proc_ts.max()))
         if sb.advance_proc_to is not None:

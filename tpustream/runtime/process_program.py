@@ -66,6 +66,7 @@ class ProcessWindowProgram(WindowProgram):
     # evaluate_fires gathers fired elements from the CURRENT state
     # buffers, so emissions cannot outlive the step that produced them
     emissions_reference_state = True
+    operator_name = "process_window"
 
     def _build_agg(self) -> None:
         # no incremental aggregation: accumulators ARE the element buffers

@@ -50,6 +50,7 @@ from .window_program import WindowProgram
 
 class SessionWindowProgram(WindowProgram):
     accepted_kinds = ("session",)
+    operator_name = "session_window"
 
     def __init__(self, plan: JobPlan, cfg):
         st = plan.stateful
@@ -621,6 +622,8 @@ class SessionProcessProgram(ProcessWindowProgram):
     lateness (:209-228), with the same Flink-exact merged-window late
     test as SessionWindowProgram.
     """
+
+    operator_name = "session_process"
 
     accepted_kinds = ("session",)
 

@@ -11,7 +11,6 @@ under ``jax.shard_map`` so XLA schedules the collectives on ICI
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
 import jax
@@ -19,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.exchange import exchange_by_key
+from ..parallel.exchange import exchange_by_key, exchange_capacity
 from ..parallel.mesh import AXIS, make_mesh
 from .count_program import (
     CountProcessProgram,
@@ -52,15 +51,9 @@ class _ShardedMixin:
         self.vary_axes = (AXIS,)
         self.local_key_capacity = cfg.key_capacity // s
         self.mesh = make_mesh(s)
-        local_b = cfg.batch_size // s
-        if cfg.exchange_capacity_factor is None:
-            # loss-free: worst-case all local records to one destination
-            self.exchange_capacity = local_b
-        else:
-            self.exchange_capacity = min(
-                local_b,
-                max(1, math.ceil(local_b / s * cfg.exchange_capacity_factor)),
-            )
+        self.exchange_capacity = exchange_capacity(
+            cfg.batch_size, s, cfg.exchange_capacity_factor
+        )
 
     def _global_max(self, x):
         return jax.lax.pmax(x, AXIS)

@@ -16,6 +16,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..api.tuples import _java_str, make_tuple
+from ..obs.registry import NULL_COUNTER
 from ..records import BOOL, F64, I64, STR
 
 
@@ -47,6 +48,11 @@ class EmissionFormatter:
 
 
 class PrintSink:
+    # per-sink emitted-record counter; the executor swaps in a real
+    # registry Counter when StreamConfig.obs.enabled (otherwise every
+    # emit pays one no-op call)
+    obs_counter = NULL_COUNTER
+
     def __init__(self, parallelism: int = 1, stream=None):
         import sys
 
@@ -68,19 +74,26 @@ class PrintSink:
             line = body
         self.lines.append(line)
         print(line, file=self.stream)
+        self.obs_counter.inc()
 
 
 class CollectSink:
+    obs_counter = NULL_COUNTER
+
     def __init__(self, handle):
         self.handle = handle
 
     def emit(self, value, subtask: Optional[int] = None) -> None:
         self.handle.append(value)
+        self.obs_counter.inc()
 
 
 class FnSink:
+    obs_counter = NULL_COUNTER
+
     def __init__(self, fn: Callable):
         self.fn = fn
 
     def emit(self, value, subtask: Optional[int] = None) -> None:
         self.fn(value)
+        self.obs_counter.inc()

@@ -67,6 +67,12 @@ class Source:
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         raise NotImplementedError  # pragma: no cover
 
+    def queue_depth(self) -> Optional[int]:
+        """Pending items buffered inside the source, for the obs layer's
+        backpressure gauge. None for sources with no internal queue
+        (replay/iterable sources hand batches straight through)."""
+        return None
+
 
 class ReplaySource(Source):
     def __init__(self, items: Iterable, start_ms: int = 0, ms_per_record: int = 0):
@@ -185,6 +191,11 @@ class SocketTextSource(Source):
         )
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+
+    def queue_depth(self) -> Optional[int]:
+        # items (lines or raw blocks) received but not yet consumed by
+        # the executor — the socket source's backpressure signal
+        return self._queue.qsize()
 
     def _reader(self) -> None:
         # lines are stamped with the wall clock AT READ TIME (Flink's

@@ -152,6 +152,30 @@ class BaseProgram:
         )[:, :k]
         return out
 
+    # short operator label for the obs layer: every runner's metric
+    # series carry {operator: <this>} (de-aliased per chain stage), the
+    # Flink-metric-group analogue of the operator name. Subclasses
+    # override with their operator kind.
+    operator_name = "operator"
+
+    # device-carried scalar state worth exposing as gauges: the event
+    # clock ("wm" — the authoritative max_seen - delay watermark),
+    # newest seen timestamp, pane-ring head, and deferred fire backlog.
+    # Fetched ONCE per job at finalize/snapshot time, never on the
+    # per-step path.
+    OBS_STATE_SCALARS = ("wm", "max_ts", "hi", "pending_fires")
+
+    def obs_state_scalars(self, state) -> dict:
+        """The subset of OBS_STATE_SCALARS present in ``state`` as 0-d
+        leaves (still on device — the caller device_gets them)."""
+        if not isinstance(state, dict):
+            return {}
+        return {
+            n: state[n]
+            for n in self.OBS_STATE_SCALARS
+            if n in state and getattr(state[n], "ndim", None) == 0
+        }
+
     # False for programs with no time semantics (per-record rolling,
     # count windows, stateless chains): a clock tick / EOS flush step can
     # never produce output for them, so the executor skips it
@@ -221,6 +245,7 @@ class StatelessProgram(BaseProgram):
 
     fires_on_clock = False
     main_emission_prefix = True
+    operator_name = "stateless"
 
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         super().__init__(plan, cfg)
@@ -250,6 +275,7 @@ class RollingProgram(BaseProgram):
     (reference chapter2/.../ComputeCpuMax.java:26)."""
 
     fires_on_clock = False
+    operator_name = "rolling"
 
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         super().__init__(plan, cfg)

@@ -67,6 +67,7 @@ def _dummy_scalar(kind: str):
 class WindowProgram(BaseProgram):
     accepted_kinds = ("tumbling", "sliding")
     main_emission_prefix = True  # append-compacted alert buffer
+    operator_name = "window"
 
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)
