@@ -295,10 +295,12 @@ def obs_snapshot_probe():
     return its metrics/trace snapshot for the JSON tail.  The job is
     deliberately small (a few dozen replayed lines, 16-row batches) —
     this phase documents the observability surface (per-operator
-    counters, watermark-lag gauge, step spans), not a rate."""
+    counters, watermark-lag gauge, step spans, end-to-end latency
+    markers, and the self-monitoring health engine), not a rate."""
     from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
     from tpustream.config import ObsConfig, StreamConfig
     from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.obs import AlertRule
     from tpustream.runtime.sources import ReplaySource
 
     lines = [
@@ -309,7 +311,18 @@ def obs_snapshot_probe():
     cfg = StreamConfig(
         batch_size=16,
         key_capacity=64,
-        obs=ObsConfig(enabled=True),
+        obs=ObsConfig(
+            enabled=True,
+            # one marker per source poll: the probe exists to show the
+            # e2e-latency surface, so stamp aggressively
+            latency_marker_interval_ms=0.001,
+            health_rules=(
+                AlertRule(
+                    name="lag_crit", metric="watermark_lag_ms",
+                    op=">", value=30_000.0, severity="crit",
+                ),
+            ),
+        ),
     )
     env = StreamExecutionEnvironment(cfg)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
@@ -1462,11 +1475,24 @@ def main():
     obs_snap = None
     try:
         obs_snap = obs_snapshot_probe()
-        n_series = len(obs_snap.get("metrics", {}).get("series", []))
+        series = obs_snap.get("metrics", {}).get("series", [])
+        n_series = len(series)
         n_spans = obs_snap.get("trace", {}).get("total_spans", 0)
+        n_markers = sum(
+            int(s["value"]) for s in series
+            if s["name"] == "latency_markers_emitted"
+        )
+        e2e_p99 = max(
+            (s["value"]["p99"] for s in series
+             if s["type"] == "histogram"
+             and s["name"].endswith("e2e_latency_ms")),
+            default=0.0,
+        )
+        health_level = obs_snap.get("health", {}).get("level", "-")
         log(
             f"phase O: obs-enabled probe job captured {n_series} metric "
-            f"series, {n_spans} step spans"
+            f"series, {n_spans} step spans; {n_markers} latency markers "
+            f"(e2e p99 {e2e_p99:.2f} ms), health {health_level}"
         )
     except Exception as e:  # pragma: no cover
         log(f"phase O skipped: {e}")

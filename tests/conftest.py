@@ -75,6 +75,7 @@ jax.config.update("jax_platforms", "cpu")
 _SLOW_FILES = {
     "test_session_windows.py",
     "test_sharded_mesh.py",
+    "test_obs_sharded.py",
     "test_config_equivalence.py",
     "test_checkpoint.py",
     "test_eventtime_jump.py",
@@ -83,6 +84,12 @@ _SLOW_FILES = {
     "test_wordplanes_liveness.py",
     "test_window_oracle.py",
     "test_distributed.py",
+    # re-tiered: _grow_key_capacity recompiles late in a long warm
+    # process intermittently segfault XLA CPU (native crash, kills the
+    # whole pytest run — see _CRASHING_TESTS below). The file passes
+    # reliably in a fresh process, so it runs in the full gate tier
+    # where a dedicated run can host it.
+    "test_key_growth.py",
 }
 # individual slow tests inside otherwise-fast files
 _SLOW_TESTS = {
@@ -92,6 +99,17 @@ _SLOW_TESTS = {
     "test_count_window_process_sharded_key_skew_no_loss",
     "test_sliding_count_window_batch_invariance_fuzz",
 }
+# quarantine hook for tests that abort the INTERPRETER (native crash),
+# not just fail — one such abort kills the whole pytest process and
+# every test collected after it. Currently empty: the intermittent
+# growth-test segfaults (XLA CPU crash inside the ``_grow_key_capacity``
+# recompile or the subsequent ``pxla`` execute, only after many prior
+# jitted programs have run in-process; the same tests pass in a fresh
+# process regardless of compile-cache state) are handled by re-tiering
+# ``test_key_growth.py`` to the slow tier above. If another file starts
+# aborting the interpreter mid-suite, add its test names here to keep
+# the tier-1 gate completing while the crash is chased.
+_CRASHING_TESTS: set = set()
 # the <60 s representative slice: one golden per chapter, the flagship
 # event-time job, and one test per major program family
 _SMOKE_TESTS = {
@@ -120,3 +138,11 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         if base in _SMOKE_TESTS:
             item.add_marker(pytest.mark.smoke)
+        if base in _CRASHING_TESTS:
+            item.add_marker(
+                pytest.mark.skip(
+                    reason="aborts the interpreter (XLA crash during "
+                    "_grow_key_capacity recompile) and takes the rest of "
+                    "the suite with it; see conftest._CRASHING_TESTS"
+                )
+            )

@@ -1,7 +1,9 @@
 """Observability layer (tpustream/obs): registry scoping, histogram
-percentiles vs a numpy oracle, Prometheus exposition golden, the
-watermark-lag gauge on a chapter-3 event-time job, the disabled-path
-overhead guard, snapshot/dump round trips, the fetch_group pipeline
+percentiles vs a numpy oracle, Prometheus exposition goldens (hostile
+label values included), the watermark-lag gauge and end-to-end latency
+markers on a chapter-3 event-time job, the health engine's CRIT rule on
+that job, the disabled-path overhead guard, snapshot/dump round trips
+(and the dump CLI's --selftest smoke mode), the fetch_group pipeline
 clamp, and the DerivedKeyTable snapshot-tear invariant."""
 
 import json
@@ -15,6 +17,7 @@ from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
 from tpustream.config import ObsConfig, StreamConfig
 from tpustream.jobs.chapter3_bandwidth_eventtime import build as build_et
 from tpustream.obs import (
+    AlertRule,
     Histogram,
     MetricsRegistry,
     NULL_JOB_OBS,
@@ -133,6 +136,20 @@ def test_prometheus_text_golden():
     )
 
 
+def test_prometheus_text_escapes_hostile_label_values():
+    """Exposition golden for a label value containing every character
+    the text format escapes: backslash, double quote, and newline."""
+    reg = MetricsRegistry()
+    reg.group(job="j", operator='he"llo\\wo\nrld').counter(
+        "operator_records_in"
+    ).inc(1)
+    assert reg.to_prometheus_text() == (
+        '# TYPE tpustream_operator_records_in counter\n'
+        'tpustream_operator_records_in'
+        '{job="j",operator="he\\"llo\\\\wo\\nrld"} 1\n'
+    )
+
+
 # ---------------------------------------------------------------------------
 # tracing + snapshot plumbing
 # ---------------------------------------------------------------------------
@@ -203,14 +220,26 @@ def test_dump_render_and_cli(tmp_path, capsys):
     assert "tpustream_operator_records_in" in capsys.readouterr().out
 
 
+def test_dump_selftest_smoke(capsys):
+    """`python -m tpustream.obs.dump --selftest` is the CI smoke mode:
+    canned registry -> snapshot -> render -> Prometheus -> health ->
+    flight dump, every check must hold."""
+    assert dump_main(["--selftest"]) == 0
+    out = capsys.readouterr().out
+    assert "selftest ok" in out
+    assert "FAIL" not in out
+
+
 # ---------------------------------------------------------------------------
 # end-to-end: chapter-3 event-time job with obs enabled / disabled
 # ---------------------------------------------------------------------------
 
+# 240 lines / 16-row batches = 15 source polls, so the per-poll latency
+# marker stamping below yields >= 10 markers through the pipeline
 ET_LINES = [
     f"2020-01-01T00:{m:02d}:{s:02d} ch{(m + s) % 3} 999999999"
-    for m in range(2)
-    for s in range(0, 60, 10)
+    for m in range(4)
+    for s in range(60)
 ]
 
 
@@ -219,12 +248,21 @@ _CH3_CACHE = {}
 
 def _run_ch3(enabled: bool):
     """One jitted job run per obs setting, shared across the e2e tests
-    (the suite is compile-bound on the 1-core driver host)."""
+    (the suite is compile-bound on the 1-core driver host). The enabled
+    run carries the full tentpole surface: latency markers on every
+    source poll and a watermark-lag health rule that the job's 1-minute
+    bounded-out-of-orderness delay is guaranteed to breach."""
     if enabled in _CH3_CACHE:
         return _CH3_CACHE[enabled]
-    cfg = StreamConfig(
-        batch_size=16, key_capacity=64, obs=ObsConfig(enabled=enabled)
+    obs = ObsConfig(
+        enabled=enabled,
+        latency_marker_interval_ms=1e-6 if enabled else 0.0,
+        health_rules=(
+            AlertRule(name="lag_crit", metric="watermark_lag_ms",
+                      op=">", value=30_000, severity="crit"),
+        ) if enabled else (),
     )
+    cfg = StreamConfig(batch_size=16, key_capacity=64, obs=obs)
     env = StreamExecutionEnvironment(cfg)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     build_et(
@@ -264,6 +302,56 @@ def test_eventtime_job_obs_enabled():
     # both exposition forms agree on the lag gauge
     assert "tpustream_watermark_lag_ms" in m.to_prometheus_text()
     assert "tpustream_watermark_lag_ms" in snap["prometheus"]
+
+
+def test_eventtime_job_latency_markers_end_to_end():
+    """Markers stamped at the source ride the full pack/dispatch/fetch
+    path and land in per-edge and per-sink e2e histograms — true
+    source->sink latency, measured without any per-record work."""
+    m = _run_ch3(enabled=True)
+    snap = m.obs_snapshot()
+    series = {(s["name"], s["labels"].get("operator")): s for s in
+              snap["metrics"]["series"]}
+
+    emitted = series[("latency_markers_emitted", None)]["value"]
+    assert emitted >= 10  # one per source poll (240 lines / 16-row batches)
+
+    for name in ("operator_e2e_latency_ms", "operator_sink0_e2e_latency_ms"):
+        h = series[(name, "window")]
+        assert h["type"] == "histogram"
+        # every marker settles: none lost in the pipelined in-flight
+        # window or the end-of-stream drain
+        assert h["value"]["count"] == emitted
+        assert h["value"]["p50"] > 0
+        assert h["value"]["p99"] >= h["value"]["p50"] > 0
+
+
+def test_eventtime_job_health_rule_goes_crit():
+    """The watermark-lag rule breaches on the job's constant 60 s lag
+    (1-minute bounded out-of-orderness) and reports CRIT in the
+    embedded health section with an explanatory reason."""
+    m = _run_ch3(enabled=True)
+    snap = m.obs_snapshot()
+    health = snap["health"]
+    assert health["level"] == "crit"
+    (rule,) = [r for r in health["rules"] if r["rule"] == "lag_crit"]
+    assert rule["level"] == "crit"
+    assert rule["value"] == 60_000
+    assert "watermark_lag_ms > 30000" in rule["reason"]
+    # the rule's own state is a scrapeable gauge (0=ok 1=warn 2=crit)
+    states = {s["labels"].get("rule"): s["value"]
+              for s in snap["metrics"]["series"]
+              if s["name"] == "health_rule_state"}
+    assert states["lag_crit"] == 2
+
+
+def test_eventtime_job_obs_disabled_no_marker_injection():
+    """obs off => the stamper is never installed: no marker series, no
+    marker objects, no e2e histograms."""
+    m = _run_ch3(enabled=False)
+    names = {s["name"] for s in m.obs_snapshot()["metrics"]["series"]}
+    assert "latency_markers_emitted" not in names
+    assert not any("e2e_latency" in n for n in names)
 
 
 def test_eventtime_job_obs_disabled_no_instrument_updates():

@@ -38,6 +38,37 @@ class ObsConfig:
     snapshot_path: str = ""           # optional JSONL file the periodic
                                       # snapshotter appends to
 
+    # -- end-to-end latency markers (obs/latency.py) ------------------------
+    latency_marker_interval_ms: float = 0.0
+    # > 0: the source stamps a LatencyMarker into the batch stream every
+    # interval; markers ride the data path (pack/dispatch/fetch/emit,
+    # through chained stages) and each operator edge / sink records the
+    # source->here age into an e2e latency histogram. 0 (default) = no
+    # stamper installed, SourceBatch.markers stays None, zero cost.
+
+    # -- self-monitoring health rules (obs/health.py) -----------------------
+    health_rules: tuple = ()
+    # AlertRule instances (or their dict form) evaluated over the
+    # registry at every snapshot tick; rule levels are gauges and
+    # transitions go to alert_sink + the flight recorder. Requires
+    # snapshot_interval_s > 0 to evaluate during the run (a final
+    # evaluation always happens at job close).
+    alert_sink: Optional[object] = None
+    # callable(transition_dict) invoked on every health level change
+    # (e.g. print, or append to an alerts file); exceptions swallowed.
+
+    # -- crash-dump flight recorder (obs/flightrecorder.py) -----------------
+    flight_recorder: bool = True      # record runtime incidents (when
+                                      # obs is enabled)
+    flight_ring_size: int = 512       # bounded event ring (O(1)/event)
+    flight_dump_path: str = ""        # where the postmortem JSON goes on
+                                      # failure; "" = <cwd>/tpustream-flight-
+                                      # <pid>.json
+    flight_watermark_jump_ms: int = 60_000
+    # watermark advances larger than this (per observation) are recorded
+    # as watermark_jump events — the classic "someone replayed old data /
+    # a partition went idle" postmortem breadcrumb
+
     def replace(self, **kw) -> "ObsConfig":
         import dataclasses
 

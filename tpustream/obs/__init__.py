@@ -16,8 +16,23 @@ the TPU-runtime equivalent:
   host spans.
 * :mod:`tpustream.obs.snapshot` — point-in-time JSON snapshots, a
   periodic snapshotter, and the Prometheus text renderer.
+* :mod:`tpustream.obs.latency` — end-to-end latency markers (Flink's
+  ``LatencyMarker``): source-stamped probes that ride the data path so
+  each operator edge and sink gets a true source→here latency
+  histogram, pipelining included.
+* :mod:`tpustream.obs.health` — declarative ``AlertRule`` set
+  (threshold / rate-of-change / absence over any registry series)
+  evaluated at snapshot ticks by a ``HealthEngine`` running an
+  OK/WARN/CRIT state machine per rule; the runtime monitoring itself
+  with the same alerting idea the reference's chapter 1 applies to CPU
+  load.
+* :mod:`tpustream.obs.flightrecorder` — bounded structured ring of
+  runtime incidents (config, compiles, watermark jumps, stalls, rule
+  transitions, the terminal exception) dumped as postmortem JSON on
+  failure or on demand.
 * ``python -m tpustream.obs.dump <snapshot.json>`` — pretty-print a
-  snapshot file.
+  snapshot file (``--health`` evaluates rules offline, ``--selftest``
+  is the CI smoke mode).
 
 Design stance: instruments update **per batch/step only** — never per
 record — and every hot-path hook has a null twin
@@ -39,6 +54,13 @@ from .registry import (  # noqa: F401
 )
 from .tracing import NULL_TRACER, StepTracer  # noqa: F401
 from .snapshot import Snapshotter, job_snapshot, write_snapshot  # noqa: F401
+from .latency import LatencyMarker, MarkerStamper, stamp_markers  # noqa: F401
+from .health import AlertRule, HealthEngine, as_rule  # noqa: F401
+from .flightrecorder import (  # noqa: F401
+    FlightRecorder,
+    NULL_FLIGHT,
+    jsonable_config,
+)
 from .runtime import (  # noqa: F401
     JobObs,
     NULL_JOB_OBS,

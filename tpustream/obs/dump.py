@@ -7,6 +7,13 @@ Accepts a single-snapshot ``.json`` (from
 :class:`~tpustream.obs.snapshot.Snapshotter`); for JSONL the last line
 is shown unless ``--index`` picks another. ``--prom`` prints the
 embedded Prometheus exposition text verbatim instead of the table view.
+``--health`` shows the snapshot's embedded health section (rule levels
+and transitions); ``--rules rules.json`` re-evaluates a rule set
+against the snapshot's series offline — postmortem alert-rule replay
+over any recorded snapshot. ``--selftest`` needs no input at all: it
+pushes a canned registry + hostile labels + alert rules through the
+whole snapshot/exposition/health path and exits nonzero on any
+mismatch (the CI smoke mode).
 
 This module deliberately imports nothing beyond the stdlib — no jax, no
 ``tpustream.runtime`` — so ``render``/``main`` are importable and
@@ -92,6 +99,10 @@ def render(snap: dict) -> str:
                 f"{_fmt_val(v['p50']):>10} {_fmt_val(v['p90']):>10} "
                 f"{_fmt_val(v['p99']):>10}  {_fmt_labels(s['labels'])}"
             )
+    health = snap.get("health")
+    if health:
+        out.append("")
+        out.append(render_health(health).rstrip("\n"))
     trace = snap.get("trace")
     if trace:
         out.append("")
@@ -113,12 +124,117 @@ def render(snap: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def render_health(health: dict) -> str:
+    """Render a snapshot's health section (see obs/health.py)."""
+    out = [f"health: {str(health.get('level', 'ok')).upper()}"]
+    rules = health.get("rules", [])
+    if rules:
+        out.append(
+            f"  {'RULE':<24} {'LEVEL':<6} {'KIND':<10} {'VALUE':>12}  REASON"
+        )
+        for r in rules:
+            out.append(
+                f"  {r.get('rule', '?'):<24} "
+                f"{str(r.get('level', '?')).upper():<6} "
+                f"{r.get('kind', '?'):<10} "
+                f"{_fmt_val(r.get('value')) if r.get('value') is not None else '-':>12}"
+                f"  {r.get('reason', '')}"
+            )
+    transitions = health.get("transitions", [])
+    if transitions:
+        out.append(f"  transitions ({len(transitions)}):")
+        for t in transitions:
+            out.append(
+                f"    t={_fmt_val(t.get('at_s', 0))}s {t.get('rule', '?')}: "
+                f"{t.get('from', '?')} -> {t.get('to', '?')} "
+                f"({t.get('reason', '')})"
+            )
+    return "\n".join(out) + "\n"
+
+
+def _selftest() -> int:
+    """CI smoke mode: a canned registry (hostile labels included) runs
+    through snapshot -> render -> Prometheus exposition -> health
+    evaluation -> flight-recorder dump, asserting on each. Everything
+    here is stdlib-only and device-free, so the tier-1 suite can invoke
+    it unconditionally."""
+    import json as _json
+
+    from .flightrecorder import FlightRecorder
+    from .health import AlertRule, HealthEngine
+    from .registry import MetricsRegistry
+    from .snapshot import job_snapshot
+
+    reg = MetricsRegistry()
+    g = reg.group(job="selftest")
+    g.counter("records_in").inc(1234)
+    g.gauge("watermark_lag_ms").set(45000)
+    h = g.histogram("e2e_latency_ms")
+    for v in (1.0, 2.0, 5.0, 10.0):
+        h.observe(v)
+    # the satellite escaping case: backslash, quote, and newline in a
+    # label value must survive the Prometheus text exposition
+    reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
+        "operator_records_in"
+    ).inc(1)
+    engine = HealthEngine(
+        [
+            AlertRule(name="lag_crit", metric="watermark_lag_ms",
+                      op=">", value=30_000),
+            AlertRule(name="throughput", metric="records_in",
+                      kind="absence", severity="warn"),
+        ],
+        gauge_group=g,
+    )
+    snap = job_snapshot(reg, meta={"job": "selftest"})
+    snap["health"] = engine.evaluate(snap["metrics"]["series"], now_s=1.0)
+    flight = FlightRecorder(capacity=4)
+    flight.record("config_resolved", config={"batch_size": 16})
+    for i in range(6):
+        flight.record("tick", i=i)
+    flight.record_exception(ValueError("boom"), operator="window")
+    dump = flight.dump(meta={"job": "selftest"})
+
+    text = render(snap)
+    prom = snap["prometheus"]
+    checks = [
+        ("render names the counter", "records_in" in text),
+        ("render names the histogram", "e2e_latency_ms" in text),
+        ("render includes health", "health: CRIT" in text),
+        ("prometheus escapes the hostile label",
+         'operator="he\\"llo\\\\wo\\nrld"' in prom),
+        ("lag rule is crit",
+         snap["health"]["rules"][0]["level"] == "crit"),
+        ("health render works",
+         "lag_crit" in render_health(snap["health"])),
+        ("flight ring bounded", len(dump["events"]) == 4),
+        ("flight counts drops", dump["dropped_events"] == 4),
+        ("flight keeps the exception",
+         dump["events"][-1]["kind"] == "exception"
+         and dump["events"][-1]["operator"] == "window"),
+        ("flight dump serializes", bool(_json.dumps(dump))),
+        ("snapshot serializes", bool(_json.dumps(snap))),
+    ]
+    failed = [name for name, ok in checks if not ok]
+    for name, ok in checks:
+        sys.stdout.write(f"{'ok' if ok else 'FAIL'}: {name}\n")
+    if failed:
+        sys.stdout.write(f"selftest FAILED ({len(failed)} checks)\n")
+        return 1
+    sys.stdout.write(f"selftest ok ({len(checks)} checks)\n")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpustream.obs.dump",
         description="Pretty-print a tpustream observability snapshot.",
     )
-    ap.add_argument("path", help="snapshot .json, Snapshotter .jsonl, or bench JSON tail")
+    ap.add_argument(
+        "path",
+        nargs="?",
+        help="snapshot .json, Snapshotter .jsonl, or bench JSON tail",
+    )
     ap.add_argument(
         "--index",
         type=int,
@@ -130,10 +246,48 @@ def main(argv=None) -> int:
         action="store_true",
         help="print the embedded Prometheus exposition text instead",
     )
+    ap.add_argument(
+        "--health",
+        action="store_true",
+        help="show only the snapshot's health section",
+    )
+    ap.add_argument(
+        "--rules",
+        help="JSON file with a list of alert-rule dicts to (re-)evaluate "
+        "against the snapshot's series",
+    )
+    ap.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the built-in smoke test (no snapshot needed)",
+    )
     args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.path:
+        ap.error("path is required (or use --selftest)")
     snap = _load(args.path, args.index)
+    if args.rules:
+        from .health import HealthEngine
+
+        with open(args.rules) as f:
+            rules = json.load(f)
+        engine = HealthEngine(rules)
+        snap["health"] = engine.evaluate(
+            snap.get("metrics", {}).get("series", []),
+            now_s=float(snap.get("meta", {}).get("at_s", 0.0)),
+        )
     if args.prom:
         sys.stdout.write(snap.get("prometheus", ""))
+    elif args.health:
+        health = snap.get("health")
+        if not health:
+            sys.stdout.write(
+                "no health section in this snapshot (configure "
+                "ObsConfig.health_rules, or pass --rules FILE)\n"
+            )
+            return 1
+        sys.stdout.write(render_health(health))
     else:
         sys.stdout.write(render(snap))
     return 0

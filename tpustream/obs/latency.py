@@ -1,0 +1,108 @@
+"""End-to-end latency markers, after Flink's ``LatencyMarker``.
+
+Batch- and step-scoped timings (``operator_step_time_s``,
+``sink_emit_latency_s``) tell you how long *one hop* took; they cannot
+answer "how long does a record take from ingestion to the sink" because
+the pipeline overlaps stages (inflight emission groups, chained
+runners, parse-ahead). Latency markers answer that directly: the
+source-side stamper emits a :class:`LatencyMarker` every
+``ObsConfig.latency_marker_interval_ms`` of wall time, and the marker
+then rides the *same* pack/dispatch/fetch/emit path as data batches —
+through every chained runner stage and emission group — so the time
+from its birth to each downstream edge is a faithful sample of true
+end-to-end latency, pipelining included.
+
+Markers are control events, not records: they are excluded from
+operator semantics (never keyed, aggregated, windowed, or emitted to
+user sinks) and never enter jitted code. Each marker is O(1) per
+*interval*, so the record path stays zero-cost — with obs disabled (or
+``latency_marker_interval_ms == 0``) the stamper is not installed at
+all and ``SourceBatch.markers`` stays ``None``.
+
+Timestamps are ``time.monotonic_ns()`` so an NTP step can never produce
+a negative latency; see :func:`tpustream.runtime.sources.monotonic_epoch_ms`
+for the same decision on the ingestion-timestamp side.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LatencyMarker:
+    """One latency probe, born at a source.
+
+    ``emitted_at_ns`` is a ``monotonic_ns`` stamp taken when the marker
+    entered the stream; ``age_ms`` against a later ``monotonic_ns``
+    reading is the source→here latency. ``trace`` accumulates the
+    ``(edge, age_ms)`` hops the marker has crossed — cheap (a handful of
+    tuples per marker) and it turns any single marker into a readable
+    per-stage latency breakdown in tests and flight dumps.
+    """
+
+    marker_id: int
+    source: str = "source"
+    emitted_at_ns: int = 0
+    trace: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.emitted_at_ns:
+            self.emitted_at_ns = time.monotonic_ns()
+
+    def age_ms(self, now_ns: int = 0) -> float:
+        return ((now_ns or time.monotonic_ns()) - self.emitted_at_ns) / 1e6
+
+    def observe(self, edge: str, now_ns: int = 0) -> float:
+        """Record this marker crossing ``edge``; returns the age in ms."""
+        age = self.age_ms(now_ns)
+        self.trace.append((edge, round(age, 3)))
+        return age
+
+
+class MarkerStamper:
+    """Decides when the next marker is due and mints it.
+
+    One stamper per job; the executor asks :meth:`poll` once per source
+    batch (batch-scoped, never per record). Interval accounting is
+    monotonic and skew-proof: after a long stall only one marker is
+    emitted, not a burst of catch-ups — markers sample latency, they do
+    not backfill it.
+    """
+
+    def __init__(self, interval_ms: float, source: str = "source",
+                 counter=None):
+        self.interval_s = max(0.0, float(interval_ms)) / 1000.0
+        self.source = source
+        self._counter = counter      # obs Counter: markers emitted
+        self._next_id = 0
+        self._last_emit_s = None     # None -> first batch gets a marker
+
+    def poll(self, now_s: float = 0.0):
+        """-> LatencyMarker if one is due at ``now_s`` (monotonic
+        seconds), else None."""
+        now_s = now_s or time.monotonic()
+        if (self._last_emit_s is not None
+                and now_s - self._last_emit_s < self.interval_s):
+            return None
+        self._last_emit_s = now_s
+        self._next_id += 1
+        m = LatencyMarker(marker_id=self._next_id, source=self.source)
+        if self._counter is not None:
+            self._counter.inc()
+        return m
+
+
+def stamp_markers(batches, stamper: MarkerStamper):
+    """Wrap a ``SourceBatch`` iterator, attaching a due marker to each
+    batch's ``markers`` list. Installed by the executor only when obs is
+    enabled and ``latency_marker_interval_ms > 0`` — the disabled path
+    iterates the raw source directly."""
+    for batch in batches:
+        m = stamper.poll()
+        if m is not None:
+            if batch.markers is None:
+                batch.markers = []
+            batch.markers.append(m)
+        yield batch

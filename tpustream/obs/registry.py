@@ -157,6 +157,19 @@ class Histogram:
             "p99": self.percentile(99),
         }
 
+    def merge_from(self, other: "Histogram") -> None:
+        """Fold ``other``'s observations into this series: count/sum
+        stay exact; samples are retained up to this ring's bound (the
+        same loss contract a single-shard ring already has)."""
+        self.count += other.count
+        self.sum += other.sum
+        for v in other.samples:
+            if self.max_samples and len(self._ring) >= self.max_samples:
+                self._ring[self._pos] = v
+                self._pos = (self._pos + 1) % self.max_samples
+            else:
+                self._ring.append(v)
+
 
 class _NullInstrument:
     """Disabled twin of every instrument: full method surface, no work.
@@ -253,6 +266,30 @@ class MetricsRegistry:
 
     def series(self) -> List[object]:
         return [self._by_key[k] for k in sorted(self._by_key)]
+
+    def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
+        """Fold another registry's series into this one, loss-free for
+        totals: counters sum, gauges take the other's last write (or its
+        pull value), histograms fold count/sum exactly and retain
+        samples up to the ring bound. Series that only exist in
+        ``other`` are minted here with the same name/labels/kind — the
+        multi-shard aggregation path: per-shard registries (distinct
+        ``shard`` labels, so nothing collides) merge into one scrape
+        view, and health rules evaluate over the merged series."""
+        for inst in other.series():
+            if inst.kind == "counter":
+                mine = self._series(Counter, inst.name, inst.labels)
+                mine.inc(inst.value)
+            elif inst.kind == "gauge":
+                mine = self._series(Gauge, inst.name, inst.labels)
+                mine.set(inst.value)
+            elif inst.kind == "histogram":
+                mine = self._series(
+                    Histogram, inst.name, inst.labels,
+                    max_samples=inst.max_samples,
+                )
+                mine.merge_from(inst)
+        return self
 
     # -- exposition --------------------------------------------------------
     def snapshot(self) -> dict:

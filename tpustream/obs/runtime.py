@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .flightrecorder import FlightRecorder, NULL_FLIGHT
+from .health import HealthEngine
 from .registry import MetricsRegistry, NULL_COUNTER, NULL_GAUGE, NULL_HISTOGRAM
 from .snapshot import Snapshotter, job_snapshot
 from .tracing import NULL_TRACER, StepTracer
@@ -93,6 +95,30 @@ class JobObs:
         )
         self._op_names: dict = {}
 
+        # crash-dump flight recorder (obs/flightrecorder.py)
+        self.flight = (
+            FlightRecorder(getattr(cfg, "flight_ring_size", 512))
+            if getattr(cfg, "flight_recorder", True)
+            else NULL_FLIGHT
+        )
+        self.flight_dump_path = getattr(cfg, "flight_dump_path", "") or ""
+
+        # self-monitoring health engine (obs/health.py); rule state
+        # gauges land in the job group so they are ordinary series
+        rules = getattr(cfg, "health_rules", ()) or ()
+        self.health = (
+            HealthEngine(
+                rules,
+                alert_sink=getattr(cfg, "alert_sink", None),
+                gauge_group=self.group,
+                flight=self.flight,
+            )
+            if rules
+            else None
+        )
+        self.snapshotter.health_engine = self.health
+        self._closed = False
+
     def operator(self, name: str) -> OperatorObs:
         """Mint the operator scope for one runner. Chained stages that
         share a program kind get de-aliased names (``window``,
@@ -116,10 +142,50 @@ class JobObs:
     def snapshot(self, meta: Optional[dict] = None) -> dict:
         m = {"job": self.job_name}
         m.update(meta or {})
-        return job_snapshot(self.registry, self.tracer, meta=m)
+        snap = job_snapshot(self.registry, self.tracer, meta=m)
+        if self.health is not None:
+            snap["health"] = self.health.state()
+        return snap
 
     def to_prometheus_text(self) -> str:
         return self.registry.to_prometheus_text()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _default_dump_path(self) -> str:
+        import os
+
+        return self.flight_dump_path or os.path.join(
+            os.getcwd(), f"tpustream-flight-{os.getpid()}.json"
+        )
+
+    def close(self, failed: bool = False) -> Optional[dict]:
+        """Terminal flush: one final snapshot (with the health engine's
+        last word) and — on failure, or whenever a dump path was
+        configured — the flight-recorder postmortem JSON. Idempotent, so
+        the failure wrapper and a user-level ``finally`` can both call
+        it."""
+        if self._closed:
+            return None
+        self._closed = True
+        snap = self.snapshotter.close()
+        dump_path = None
+        if self.flight.enabled and (failed or self.flight_dump_path):
+            dump_path = self._default_dump_path()
+            try:
+                self.flight.write(
+                    dump_path,
+                    meta={"job": self.job_name, "failed": bool(failed)},
+                )
+            except OSError:
+                dump_path = None
+        return {"snapshot": snap, "flight_dump_path": dump_path}
+
+    def on_failure(self, exc: BaseException, operator: str = "") -> None:
+        """Record the terminal exception (with the operator that was
+        active) and write the postmortem bundle."""
+        self.flight.record_exception(exc, operator)
+        self.close(failed=True)
 
 
 class _NullOperatorObs:
@@ -160,6 +226,9 @@ class _NullJobObs:
     tracer = NULL_TRACER
     job_name = ""
     snapshotter = None
+    flight = NULL_FLIGHT
+    health = None
+    flight_dump_path = ""
 
     __slots__ = ()
 
@@ -180,6 +249,12 @@ class _NullJobObs:
 
     def to_prometheus_text(self) -> str:
         return ""
+
+    def close(self, failed: bool = False):
+        return None
+
+    def on_failure(self, exc: BaseException, operator: str = "") -> None:
+        pass
 
 
 NULL_JOB_OBS = _NullJobObs()

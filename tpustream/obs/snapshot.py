@@ -70,6 +70,11 @@ class Snapshotter:
         self.snapshots: List[dict] = []
         self._last = time.perf_counter()
         self._t0 = self._last
+        # optional HealthEngine: evaluated at every take(), so alert
+        # rules tick exactly as often as snapshots (the design point:
+        # self-monitoring shares the snapshot cadence, no extra timers)
+        self.health_engine = None
+        self.closed = False
 
     @property
     def enabled(self) -> bool:
@@ -90,6 +95,12 @@ class Snapshotter:
             at_s = time.perf_counter() - self._t0
         meta["at_s"] = round(at_s, 6)
         snap = job_snapshot(self.registry, self.tracer, meta=meta)
+        if self.health_engine is not None:
+            # evaluate AFTER the registry snapshot so rules see exactly
+            # the series this snapshot carries
+            snap["health"] = self.health_engine.evaluate(
+                snap["metrics"].get("series", []), now_s=at_s
+            )
         self.snapshots.append(snap)
         if len(self.snapshots) > self.max_snapshots:
             del self.snapshots[0 : len(self.snapshots) - self.max_snapshots]
@@ -100,3 +111,18 @@ class Snapshotter:
             except OSError:
                 pass
         return snap
+
+    def close(self) -> Optional[dict]:
+        """Final flush at job end (success OR failure): take one last
+        snapshot so the JSONL tail always reflects the terminal state —
+        a run whose last interval never elapsed would otherwise lose its
+        final counters, and a health engine its final evaluation.
+        Idempotent; returns the terminal snapshot (or None when there is
+        nothing to flush)."""
+        if self.closed:
+            return self.snapshots[-1] if self.snapshots else None
+        self.closed = True
+        if not (self.enabled or self.jsonl_path
+                or self.health_engine is not None):
+            return None
+        return self.take()

@@ -411,6 +411,15 @@ class Runner:
         depth = 1 if self.program.emissions_reference_state else cfg.async_depth
         self._max_inflight = max(0, depth - 1)
         self._inflight: List[tuple] = []
+        # end-to-end latency markers (obs/latency.py): markers ride the
+        # inflight entries like data, so the source->edge age includes
+        # real pipelining delay. Pending markers attach to the NEXT
+        # step; recorded markers park in _marker_out until pump_chain
+        # hands them downstream. Both stay empty unless the source
+        # stamper is installed (obs on + latency_marker_interval_ms > 0).
+        self._pending_markers: List = []
+        self._marker_out: List = []
+        self._flight = metrics.job_obs.flight
         # rows of the last firing step's 'main' prefix (speculative
         # count+emission piggyback fetch, _speculative_main); 0 until
         # the first firing step establishes a scale
@@ -494,6 +503,21 @@ class Runner:
                 sink.obs_counter = self.obs.counter(f"sink{i}_emitted")
             for tag, (_, sink) in self.side_sinks.items():
                 sink.obs_counter = self.obs.counter(f"side_sink{tag}_emitted")
+        # marker latency series: source->this-operator-edge, and (for
+        # the terminal stage) source->each-sink. Null instruments when
+        # obs is off — and markers never exist then anyway.
+        self._e2e_hist = self.obs.histogram("e2e_latency_ms")
+        self._sink_e2e = [
+            self.obs.histogram(f"sink{i}_e2e_latency_ms")
+            for i in range(len(self.sinks))
+        ]
+        # flight breadcrumb: one per program compile (no-op when obs off)
+        self._flight.record(
+            "program_built",
+            operator=self.obs.name or self.program.operator_name,
+            key_capacity=cfg.key_capacity,
+            shards=self.program.n_shards,
+        )
 
     _COUNTER_NAMES = (
         "window_fires", "late_dropped", "alert_overflow",
@@ -555,6 +579,12 @@ class Runner:
         # state (host-evaluated fires read self.state) — settle them
         self.drain_inflight()
         new_cap = new_capacity or self.cfg.key_capacity * 2
+        self._flight.record(
+            "key_capacity_grown",
+            operator=self.obs.name or self.program.operator_name,
+            old_capacity=self.cfg.key_capacity,
+            new_capacity=new_cap,
+        )
         old_prog = self.program
         # key-sharded leaves fetch LOCAL shards only (the migration is
         # shard-local: every key keeps its shard and local row, so no
@@ -738,8 +768,11 @@ class Runner:
         per_shard = local_len // local_shards
         return jax.process_index() * local_shards * per_shard
 
-    def feed(self, batch: Batch, wm_lower: int, t_batch: Optional[float] = None):
+    def feed(self, batch: Batch, wm_lower: int, t_batch: Optional[float] = None,
+             markers=None):
         cfg = self.cfg
+        if markers:
+            self._pending_markers.extend(markers)
         self._check_capacity()
         if t_batch is None:
             t_batch = time.perf_counter()
@@ -839,6 +872,7 @@ class Runner:
             valid = self._gshard(valid)
             ts_p = self._gshard(ts_p)
         self._step_idx += 1
+        self._flight.set_active(self.obs.name or self.program.operator_name)
         with self.obs.span("dispatch", self._step_idx):
             with Stopwatch() as sw:
                 self.state, emissions, counts = self.step(
@@ -850,7 +884,20 @@ class Runner:
         self.metrics.step_times_s.append(sw.elapsed)
         self.obs.steps.inc()
         self.obs.dispatch_time_s.observe(sw.elapsed)
-        self._inflight.append((emissions, counts, t_batch))
+        # markers ride this step's inflight entry: their source->edge
+        # latency is recorded when the entry's emissions dispatch, so
+        # pipelining delay (async_depth, fetch_group) is measured, not
+        # hidden
+        # detach, never alias: an empty ``_pending_markers`` must not ride
+        # the entry as a live reference, or markers accepted while this
+        # step is in flight would appear in it retroactively AND drain
+        # into a later step — recording twice
+        if self._pending_markers:
+            step_markers = self._pending_markers
+            self._pending_markers = []
+        else:
+            step_markers = ()
+        self._inflight.append((emissions, counts, t_batch, step_markers))
         self.obs.inflight.set(len(self._inflight))
         while len(self._inflight) > self._max_inflight:
             g = self._fetch_group
@@ -881,6 +928,43 @@ class Runner:
             g = self._fetch_group
             for s in range(0, len(entries), g):
                 self._finish_group(entries[s : s + g])
+
+    # -- latency markers (obs/latency.py) ----------------------------------
+
+    def accept_markers(self, markers) -> None:
+        """Markers arriving at this stage (from the source stamper or the
+        upstream stage); they ride the next step's inflight entry."""
+        if markers:
+            self._pending_markers.extend(markers)
+
+    def _record_markers(self, markers) -> None:
+        """A dispatched step's markers have now crossed this operator
+        edge: record source->here age, then route them onward — to the
+        downstream stage, or (terminal stage) across every sink edge."""
+        now_ns = time.monotonic_ns()
+        edge = self.obs.name or self.program.operator_name
+        for m in markers:
+            self._e2e_hist.observe(m.observe(edge, now_ns))
+        if self.downstream is not None:
+            self._marker_out.extend(markers)
+            return
+        for i, h in enumerate(self._sink_e2e):
+            for m in markers:
+                h.observe(m.observe(f"sink{i}", now_ns))
+
+    def settle_markers(self) -> None:
+        """End of stream: no further steps will run, so record any
+        marker still waiting for one (guarantees markers are never lost
+        — the e2e series always reflects every stamped marker), then
+        cascade down the chain."""
+        if self._pending_markers:
+            ms, self._pending_markers = self._pending_markers, []
+            self._record_markers(ms)
+        if self.downstream is not None:
+            if self._marker_out:
+                self.downstream.accept_markers(self._marker_out)
+                self._marker_out = []
+            self.downstream.settle_markers()
 
     def chain_to(self, downstream: "Runner"):
         self.downstream = downstream
@@ -1034,6 +1118,11 @@ class Runner:
             d = self._build_lazy_downstream()
         if d is None:
             return
+        if self._marker_out:
+            # markers recorded at this edge continue downstream with the
+            # same pump that moves the data they travelled with
+            d.accept_markers(self._marker_out)
+            self._marker_out = []
         fed = False
         if self._chain_rows:
             cols, ts, kinds, tables = self._rows_to_cols()
@@ -1224,10 +1313,10 @@ class Runner:
                 )
                 cnts_list = [cnts0]
             else:
-                cnts_list = jax.device_get([c for _, c, _ in entries])
+                cnts_list = jax.device_get([c for _, c, _, _ in entries])
             fetches = [
                 self._plan_fetch(em, cnts)
-                for (em, _, _), cnts in zip(entries, cnts_list)
+                for (em, _, _, _), cnts in zip(entries, cnts_list)
             ]
             pre_fetched: List[dict] = [{} for _ in fetches]
             if self._spec_eligible(entries):
@@ -1260,6 +1349,8 @@ class Runner:
         for (entry, pre, fetched) in zip(entries, pre_fetched, fetched_list):
             fetched.update(pre)
             self._dispatch(fetched, entry[2])
+            if entry[3]:
+                self._record_markers(entry[3])
 
     def finalize_metrics(self):
         """Fold the device-side cumulative counters into Metrics (one
@@ -1664,20 +1755,51 @@ def _prefetch_iter(it, depth: int, depth_gauge=None):
 
 
 def execute_job(env, sink_nodes) -> JobResult:
+    """Run the job; on ANY failure, write the flight-recorder postmortem
+    (terminal exception + the operator that was active + the event ring)
+    before re-raising. ``env.metrics`` is installed as soon as the
+    Metrics facade exists, so even a crashed job leaves its partial
+    counters readable."""
+    try:
+        result = _execute_job(env, sink_nodes)
+    except BaseException as e:
+        job_obs = getattr(getattr(env, "metrics", None), "job_obs", None)
+        if job_obs is not None:
+            job_obs.on_failure(e)
+        raise
+    job_obs = getattr(env.metrics, "job_obs", None)
+    if job_obs is not None:
+        job_obs.close()
+    return result
+
+
+def _execute_job(env, sink_nodes) -> JobResult:
     cfg = env.config
     plans = build_plan_chain(env, sink_nodes)
     plan = plans[0]
     chained = len(plans) > 1
     host = HostStage(plan, cfg)
     if cfg.obs.enabled:
+        from ..obs.flightrecorder import jsonable_config
         from ..obs.runtime import JobObs
 
         job_obs = JobObs(cfg.obs, job_name=env.job_name or "job")
         metrics = Metrics(registry=job_obs.registry, job_name=job_obs.job_name)
         metrics.job_obs = job_obs
+        # first flight event: the exact resolved config — every
+        # postmortem starts from the knobs the job actually ran with
+        job_obs.flight.record(
+            "config_resolved",
+            job=job_obs.job_name,
+            config=jsonable_config(cfg),
+        )
     else:
         metrics = Metrics()
         job_obs = metrics.job_obs  # the null twin
+    # installed BEFORE the run so the failure wrapper (and the user, via
+    # env) can reach the partial metrics of a crashed job; the facade
+    # mutates in place from here on
+    env.metrics = metrics
     # host-side watermark gauges: fed per batch from the job's periodic
     # timestamp assigner (Flink's currentInputWatermark / watermark-lag
     # operator metrics). The device carries the authoritative clock; this
@@ -1747,6 +1869,12 @@ def execute_job(env, sink_nodes) -> JobResult:
     # forcing a full drain every batch.)
     t_iter_done: Optional[float] = None
     IDLE_GAP_S = 0.05
+    # markers from source batches that carried no feedable data yet
+    # (idle ticks, pre-first-batch); they attach to the next real step
+    marker_backlog: List = []
+    # previous host watermark, for the flight recorder's jump detector
+    wm_prev: Optional[int] = None
+    STALL_GAP_S = 1.0  # source gaps beyond this become flight events
 
     def wm_lower_for_records(wm_hint: Optional[int]) -> int:
         if domain == TimeCharacteristic.ProcessingTime:
@@ -1775,12 +1903,12 @@ def execute_job(env, sink_nodes) -> JobResult:
                     rest = sb.raw[off:]
                 sb = SourceBatch(
                     [], sb.proc_ts[take:], sb.advance_proc_to, sb.final,
-                    raw=rest, n_raw=sb.n_raw - take,
+                    raw=rest, n_raw=sb.n_raw - take, markers=sb.markers,
                 )
             else:
                 sb = SourceBatch(
                     sb.lines[take:], sb.proc_ts[take:], sb.advance_proc_to,
-                    sb.final,
+                    sb.final, markers=sb.markers,
                 )
             skip_state[0] -= take
         batch = wm_hint = None
@@ -1804,9 +1932,22 @@ def execute_job(env, sink_nodes) -> JobResult:
                 batch, wm_hint = host.process(sb.lines, sb.proc_ts)
         return sb, batch, wm_hint, hw
 
-    prepared = map(
-        _prepare, plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms)
-    )
+    source_batches = plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms)
+    if job_obs.enabled and cfg.obs.latency_marker_interval_ms > 0:
+        # e2e latency markers: stamped at the source, riding the same
+        # pack/dispatch/fetch/emit path as records (obs/latency.py).
+        # Not installed otherwise — the disabled path iterates the raw
+        # source with no per-batch marker work at all.
+        from ..obs.latency import MarkerStamper, stamp_markers
+
+        source_batches = stamp_markers(
+            source_batches,
+            MarkerStamper(
+                cfg.obs.latency_marker_interval_ms,
+                counter=job_obs.counter("latency_markers_emitted"),
+            ),
+        )
+    prepared = map(_prepare, source_batches)
     prefetched = cfg.parse_ahead > 0 and jax.process_count() == 1
     if prefetched:
         # source + parse on their own thread (the reference's source-
@@ -1830,6 +1971,15 @@ def execute_job(env, sink_nodes) -> JobResult:
         src_gap = (
             now_ref - t_iter_done if t_iter_done is not None else 0.0
         )
+        if src_gap > STALL_GAP_S:
+            # per-incident, not per-batch: a stalled source records one
+            # event per observed gap, bounded by the gap itself
+            job_obs.flight.record(
+                "source_stall", gap_s=round(src_gap, 3),
+                batches_consumed=metrics.batches,
+            )
+        if sb.markers:
+            marker_backlog.extend(sb.markers)
         lines_consumed += sb.n_records
         metrics.host_times_s.append(hw.elapsed)
         metrics.batches += 1
@@ -1838,10 +1988,22 @@ def execute_job(env, sink_nodes) -> JobResult:
             # per-batch host watermark bookkeeping (obs-gated): observe
             # the batch max, then read the monotone watermark + its lag
             assigner.observe(int(batch.ts.max()))
-            wm_gauge.set(assigner.get_current_watermark().timestamp)
+            wm_now = assigner.get_current_watermark().timestamp
+            wm_gauge.set(wm_now)
             lag = getattr(assigner, "current_lag_ms", None)
             if lag is not None:
                 lag_gauge.set(lag())
+            if (
+                wm_prev is not None
+                and wm_now - wm_prev > cfg.obs.flight_watermark_jump_ms
+            ):
+                # the classic postmortem breadcrumb: a replay of old
+                # data or an idle partition makes the watermark leap
+                job_obs.flight.record(
+                    "watermark_jump", from_ms=wm_prev, to_ms=wm_now,
+                    jump_ms=wm_now - wm_prev,
+                )
+            wm_prev = wm_now
         job_obs.maybe_snapshot()
         if sb.proc_ts.size:
             proc_now = max(proc_now, int(sb.proc_ts.max()))
@@ -1860,6 +2022,9 @@ def execute_job(env, sink_nodes) -> JobResult:
                 and t_iter_done is not None
                 and src_gap > IDLE_GAP_S
             )
+            if marker_backlog:
+                runner.accept_markers(marker_backlog)
+                marker_backlog = []
             runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
             if idle:
                 runner.drain_inflight()
@@ -1868,6 +2033,9 @@ def execute_job(env, sink_nodes) -> JobResult:
             and runner is not None
             and domain == TimeCharacteristic.ProcessingTime
         ):
+            if marker_backlog:
+                runner.accept_markers(marker_backlog)
+                marker_backlog = []
             runner.flush(proc_now - 1)
         if runner is not None:
             runner.pump_chain(proc_now)
@@ -1943,6 +2111,10 @@ def execute_job(env, sink_nodes) -> JobResult:
     # Flink's source-function return emits a Long.MAX_VALUE watermark that
     # fires every remaining event-time window — match that here
     if runner is not None:
+        if marker_backlog:
+            # final markers ride the end-of-stream flush step
+            runner.accept_markers(marker_backlog)
+            marker_backlog = []
         if domain == TimeCharacteristic.ProcessingTime:
             runner.flush(proc_now - 1)
         else:
@@ -1962,11 +2134,13 @@ def execute_job(env, sink_nodes) -> JobResult:
             d.flush(MAX_WATERMARK)
             d.drain_inflight()
             r = d
+        # markers that never met another step (EOS right behind them)
+        # still record at every remaining edge — no marker is lost
+        runner.settle_markers()
         r = runner
         while r is not None:
             r.finalize_metrics()
             r.check_strict()
             r = r.downstream
 
-    env.metrics = metrics
     return JobResult(metrics)

@@ -18,6 +18,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+try:  # jax >= 0.6 exposes shard_map at the top level
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KWARGS: dict = {}
+except AttributeError:  # older jax: the experimental namespace, whose
+    # replication checker predates while_loop support (VMA tracking
+    # replaced it upstream) — disable it rather than fail to trace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KWARGS = {"check_rep": False}
+
 from ..parallel.exchange import exchange_by_key, exchange_capacity
 from ..parallel.mesh import AXIS, make_mesh
 from .count_program import (
@@ -90,11 +100,12 @@ class _ShardedMixin:
         )
         # all emission leaves carry per-shard rows
         out_specs = (state_specs, P(AXIS))
-        fn = jax.shard_map(
+        fn = _shard_map(
             self._step,
             mesh=self.mesh,
             in_specs=in_specs,
             out_specs=out_specs,
+            **_SHARD_MAP_KWARGS,
         )
         return jax.jit(fn, donate_argnums=0)
 

@@ -24,6 +24,21 @@ from typing import Iterable, Iterator, List, Optional
 
 import numpy as np
 
+# Wall-anchored monotonic clock for INTERNAL timestamps (ingestion
+# stamps, processing-time idle ticks). Anchoring time.monotonic() to the
+# wall clock once at import gives epoch-ms values comparable across the
+# process, while steps in the system clock (NTP corrections, manual
+# resets) can never run the stamp stream backwards — a backwards step
+# used to produce negative queue/e2e latencies and non-monotone
+# proc-time clocks. Event-time semantics (record-embedded timestamps,
+# watermarks) stay genuinely wall-clock and are unaffected.
+_EPOCH_MS = _time.time() * 1000.0 - _time.monotonic() * 1000.0
+
+
+def monotonic_epoch_ms() -> int:
+    """Epoch milliseconds from a monotonic clock anchored at import."""
+    return int(_EPOCH_MS + _time.monotonic() * 1000.0)
+
 
 @dataclass
 class SourceBatch:
@@ -42,6 +57,9 @@ class SourceBatch:
     final: bool = False                 # end of stream
     raw: Optional[bytes] = None         # newline-separated buffer
     n_raw: int = 0                      # line count of ``raw``
+    markers: Optional[list] = None      # obs LatencyMarkers riding this
+                                        # batch (None unless the obs
+                                        # stamper is installed)
 
     @property
     def n_records(self) -> int:
@@ -148,7 +166,7 @@ class IterableSource(Source):
 
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         lines: List[str] = []
-        now = lambda: int(_time.time() * 1000)
+        now = monotonic_epoch_ms
         for line in self._it:
             lines.append(line)
             if len(lines) >= batch_size:
@@ -240,12 +258,12 @@ class SocketTextSource(Source):
                     line, buf = buf.split(b"\n", 1)
                     self._queue.put(
                         (line.decode("utf-8", "replace").rstrip("\r"),
-                         int(_time.time() * 1000))
+                         monotonic_epoch_ms())
                     )
             if buf:
                 self._queue.put(
                     (buf.decode("utf-8", "replace").rstrip("\r"),
-                     int(_time.time() * 1000))
+                     monotonic_epoch_ms())
                 )
 
     def _read_stream_raw(self, sock_cm) -> None:
@@ -264,10 +282,10 @@ class SocketTextSource(Source):
                 if b"\r" in block:  # CRLF parity with the line mode
                     block = block.replace(b"\r\n", b"\n")
                 n = block.count(b"\n")
-                self._queue.put((block, n, int(_time.time() * 1000)))
+                self._queue.put((block, n, monotonic_epoch_ms()))
             if tail:
                 self._queue.put(
-                    (tail.rstrip(b"\r") + b"\n", 1, int(_time.time() * 1000))
+                    (tail.rstrip(b"\r") + b"\n", 1, monotonic_epoch_ms())
                 )
 
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
@@ -293,7 +311,7 @@ class SocketTextSource(Source):
                     break
                 items.append(item)
                 total += item[1] if self.raw else 1
-            now = int(_time.time() * 1000)
+            now = monotonic_epoch_ms()
             # idle ticks still advance the processing-time clock so
             # processing-time windows fire without fresh input
             if self.raw:
