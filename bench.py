@@ -1489,12 +1489,49 @@ def main():
             default=0.0,
         )
         health_level = obs_snap.get("health", {}).get("level", "-")
+
+        # device-side registries (docs/observability.md): per-operator
+        # compile accounting and HBM state footprint, folded out of the
+        # snapshot so the JSON tail answers "what did XLA build and what
+        # does its state cost" without spelunking the raw series
+        def _by_op(name, value=lambda s: s["value"]):
+            return {
+                s["labels"].get("operator", "-"): value(s)
+                for s in series
+                if s["name"] == name and "operator" in s["labels"]
+            }
+
+        compile_summary = {
+            "compiles": _by_op("operator_compile_count"),
+            "recompiles": _by_op("operator_recompile_count"),
+            "wall_ms_p50": _by_op(
+                "operator_compile_wall_ms", lambda s: s["value"]["p50"]
+            ),
+            "flops": _by_op("operator_compile_flops"),
+            "bytes_accessed": _by_op("operator_compile_bytes_accessed"),
+        }
+        state_memory = {
+            "hbm_state_bytes": _by_op("operator_hbm_state_bytes"),
+            "component_bytes": {
+                f"{s['labels'].get('operator', '-')}"
+                f"/{s['labels'].get('component', '-')}": s["value"]
+                for s in series
+                if s["name"] == "operator_state_component_bytes"
+            },
+            "key_table_load_factor": _by_op("operator_key_table_load_factor"),
+            "key_cardinality": _by_op("operator_key_cardinality"),
+            "hot_key_share": _by_op("operator_hot_key_share"),
+        }
+        n_compiles = sum(compile_summary["compiles"].values())
+        hbm_total = sum(state_memory["hbm_state_bytes"].values())
         log(
             f"phase O: obs-enabled probe job captured {n_series} metric "
             f"series, {n_spans} step spans; {n_markers} latency markers "
-            f"(e2e p99 {e2e_p99:.2f} ms), health {health_level}"
+            f"(e2e p99 {e2e_p99:.2f} ms), health {health_level}; "
+            f"{n_compiles} XLA builds, {hbm_total / 1e3:.1f} KB device state"
         )
     except Exception as e:  # pragma: no cover
+        compile_summary = state_memory = None
         log(f"phase O skipped: {e}")
 
     print(
@@ -1579,6 +1616,11 @@ def main():
                     # probe job (docs/observability.md; render with
                     # `python -m tpustream.obs.dump`)
                     "obs_snapshot": obs_snap,
+                    # and its device-side registries, folded: what XLA
+                    # built (count/cause/wall/cost) and what the state
+                    # pytree costs in HBM per operator/component
+                    "compile_summary": compile_summary,
+                    "state_memory": state_memory,
                 },
             }
         ),

@@ -8,6 +8,7 @@ differential-checks against a run whose static capacity was always big
 enough.
 """
 
+import jax
 import pytest
 
 from tpustream import (
@@ -19,6 +20,21 @@ from tpustream import (
 )
 from tpustream.config import StreamConfig
 from tpustream.runtime.sources import ReplaySource
+
+
+@pytest.fixture(autouse=True)
+def _fresh_compilation_cache(tmp_path):
+    """Growth tests run against a cold per-test compilation cache: on
+    this jax/XLA CPU build, executing a cache-deserialized executable
+    against donated buffers segfaults intermittently after a growth
+    rebuild (the reason this file was re-tiered slow). A cold cache
+    keeps every dispatch on the freshly-built in-memory executable."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "cc"))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 class Ts(BoundedOutOfOrdernessTimestampExtractor):

@@ -7,6 +7,7 @@ that job, the disabled-path overhead guard, snapshot/dump round trips
 clamp, and the DerivedKeyTable snapshot-tear invariant."""
 
 import json
+import math
 import threading
 import types
 
@@ -87,7 +88,21 @@ def test_gauge_set_fn_pull_and_exception_swallow():
         raise RuntimeError("queue gone")
 
     g.set_fn(boom)
-    assert g.value == 9  # last good value kept
+    # a dead callback is VISIBLE, not papered over: the read renders NaN
+    # (a stale last-good value would hide the outage from dashboards)
+    # and the failure is attributed in its own error counter
+    assert math.isnan(g.value)
+    assert math.isnan(g.value)  # stable across repeated scrapes
+    errs = [
+        s for s in reg.series()
+        if s.name == "gauge_callback_errors"
+    ]
+    assert len(errs) == 1
+    assert errs[0].labels == {"job": "j", "gauge": "depth"}
+    assert errs[0].value == 2
+    # NaN must survive prometheus rendering, not crash the formatter
+    text = reg.to_prometheus_text()
+    assert "tpustream_depth" in text and "NaN" in text
 
 
 def test_histogram_percentiles_match_numpy():

@@ -1,0 +1,262 @@
+"""Device-side observability: the compile/recompile registry
+(obs/compilation.py) and the HBM state-memory / key-skew accounting
+(obs/memory.py), unit-level over a bare registry and end-to-end through
+obs-enabled jobs."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.jobs.chapter3_bandwidth_eventtime import build as build_et
+from tpustream.obs import CompileObs, MetricsRegistry
+from tpustream.obs.flightrecorder import FlightRecorder
+from tpustream.obs.runtime import OperatorObs
+from tpustream.obs.tracing import NULL_TRACER
+from tpustream.runtime.sources import ReplaySource
+
+
+# ---------------------------------------------------------------------------
+# InstrumentedStep over a bare registry
+# ---------------------------------------------------------------------------
+
+
+def _compile_obs():
+    reg = MetricsRegistry()
+    group = reg.group(job="t", operator="op")
+    flight = FlightRecorder(64)
+    return CompileObs(OperatorObs(group, NULL_TRACER), flight), reg, flight
+
+
+def _series(reg):
+    return {(s.name, s.labels.get("cause")): s for s in reg.series()}
+
+
+def test_instrumented_step_counts_compiles_and_causes():
+    cobs, reg, flight = _compile_obs()
+
+    def f(state, x):
+        return state + x, state.sum()
+
+    step = cobs.instrument(f, cause="initial", donate_argnums=())
+    out1, s1 = step(jnp.zeros((4,)), jnp.ones((4,)))
+    out2, _ = step(jnp.zeros((4,)), jnp.ones((4,)))  # same aval: cached
+    assert out1.tolist() == out2.tolist() == [1.0] * 4
+
+    s = _series(reg)
+    assert s[("operator_compile_count", None)].value == 1
+    assert s[("operator_recompile_count", None)].value == 0
+    assert s[("operator_compile_wall_ms", None)].count == 1
+    assert s[("operator_compile_wall_ms", None)].sum > 0
+
+    # a new input signature is a recompile, attributed to shape change
+    out3, _ = step(jnp.zeros((8,)), jnp.ones((8,)))
+    assert out3.shape == (8,)
+    s = _series(reg)
+    assert s[("operator_compile_count", None)].value == 2
+    assert s[("operator_recompile_count", None)].value == 1
+    assert s[("operator_recompile_cause", "batch_shape_change")].value == 1
+    assert s[("operator_compile_wall_ms", None)].count == 2
+
+    events = [
+        e for e in flight.dump()["events"] if e["kind"] == "program_compiled"
+    ]
+    assert [e["cause"] for e in events] == ["initial", "batch_shape_change"]
+    assert all(e["wall_ms"] > 0 for e in events)
+
+
+def test_instrumented_step_records_xla_cost_and_memory_gauges():
+    cobs, reg, _ = _compile_obs()
+
+    def f(x):
+        return (x @ x.T).sum()
+
+    step = cobs.instrument(f, cause="initial", donate_argnums=())
+    step(jnp.ones((16, 16)))
+    names = {s.name for s in reg.series()}
+    # CPU provides both analyses; the gauges must be populated, not
+    # merely minted
+    by_name = {s.name: s for s in reg.series()}
+    assert by_name["operator_compile_flops"].value > 0
+    assert by_name["operator_compile_bytes_accessed"].value > 0
+    assert "operator_compile_output_bytes" in names
+    assert by_name["operator_compile_output_bytes"].value >= 0
+
+
+def test_instrumented_step_fallback_on_lower_failure():
+    cobs, reg, flight = _compile_obs()
+
+    class _NoLower:
+        """jit stand-in whose AOT path is broken but dispatch works."""
+
+        def __call__(self, x):
+            return x + 1
+
+        def lower(self, *a):
+            raise RuntimeError("no AOT here")
+
+    step = cobs.instrument(lambda x: x + 1, cause="initial",
+                           donate_argnums=())
+    step._jit = _NoLower()
+    assert step(jnp.zeros((2,))).tolist() == [1.0, 1.0]
+    # the build still counted (via the dispatch wall time), and the
+    # fallback left a breadcrumb; later calls skip the AOT path
+    s = _series(reg)
+    assert s[("operator_compile_count", None)].value == 1
+    assert s[("operator_compile_instrument_fallback", None)].value == 1
+    assert step._fallback
+    kinds = [e["kind"] for e in flight.dump()["events"]]
+    assert "compile_instrument_fallback" in kinds
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: jobs populate the device-side series
+# ---------------------------------------------------------------------------
+
+
+def _lines(n=240, channels=3, hot=None):
+    """Replay lines over ``channels`` distinct keys; ``hot`` (0..1)
+    skews that fraction of rows onto channel 0."""
+    out = []
+    for i in range(n):
+        if hot is not None and (i % 100) < hot * 100:
+            ch = 0
+        else:
+            ch = i % channels
+        out.append(
+            f"2020-01-01T00:{i // 60:02d}:{i % 60:02d} ch{ch} 1234567"
+        )
+    return out
+
+
+def _run(lines, **cfg_kw):
+    cfg_kw.setdefault("batch_size", 16)
+    cfg_kw.setdefault("key_capacity", 64)
+    cfg = StreamConfig(obs=ObsConfig(enabled=True), **cfg_kw)
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    h = build_et(
+        env,
+        env.add_source(ReplaySource(lines)),
+        size=Time.seconds(30),
+        slide=Time.seconds(10),
+        delay=Time.seconds(5),
+    ).collect()
+    env.execute("device-obs")
+    snap = env.metrics.obs_snapshot()
+    series = {}
+    for s in snap["metrics"]["series"]:
+        key = (s["name"], s["labels"].get("operator"))
+        series.setdefault(key, []).append(s)
+    return env, series
+
+
+def _one(series, name, operator="window"):
+    (s,) = series[(name, operator)]
+    return s
+
+
+def test_key_table_gauges_track_inserts():
+    _, series = _run(_lines(n=240, channels=5))
+    assert _one(series, "operator_key_table_capacity")["value"] == 64
+    assert _one(series, "operator_key_table_occupancy")["value"] == 5
+    assert _one(series, "operator_key_table_load_factor")["value"] == 5 / 64
+    assert _one(series, "operator_key_cardinality")["value"] == 5
+    assert _one(series, "operator_key_updates")["value"] == 240
+
+
+def test_component_bytes_sum_to_hbm_total():
+    _, series = _run(_lines())
+    total = _one(series, "operator_hbm_state_bytes")["value"]
+    assert total > 0
+    comps = series[("operator_state_component_bytes", "window")]
+    assert sum(s["value"] for s in comps) == total
+    by_comp = {s["labels"]["component"]: s["value"] for s in comps}
+    # the window program's footprint is dominated by its pane ring
+    assert by_comp["pane_ring"] > by_comp.get("scalars", 0)
+
+
+def test_hot_key_skew_gauges_flag_the_hot_key():
+    env, series = _run(_lines(n=300, channels=10, hot=0.6))
+    share = _one(series, "operator_hot_key_share")["value"]
+    assert 0.55 < share < 0.75  # ch0 takes 60% of rows + its round-robin turns
+    hot_id = int(_one(series, "operator_hot_key_id")["value"])
+    # contrast with a uniform run: a balanced key mix has no dominant key
+    _, balanced = _run(_lines(n=300, channels=10))
+    bal_share = _one(balanced, "operator_hot_key_share")["value"]
+    assert bal_share < share
+    assert bal_share <= 0.2
+    assert hot_id >= 0
+
+
+def test_job_compile_registry_single_build():
+    env, series = _run(_lines())
+    assert _one(series, "operator_compile_count")["value"] == 1
+    assert _one(series, "operator_recompile_count")["value"] == 0
+    wall = _one(series, "operator_compile_wall_ms")["value"]
+    assert wall["count"] == 1 and wall["sum"] > 0
+    events = [
+        e for e in env.metrics.job_obs.flight.dump()["events"]
+        if e["kind"] == "program_compiled"
+    ]
+    assert len(events) == 1 and events[0]["cause"] == "initial"
+    # the compile event carries the chain-complexity meta from
+    # DeviceChain.describe() (this job's device pre-chain is empty —
+    # parse runs host-side — but the fields must be present)
+    assert events[0]["chain_ops"] == 0
+    assert events[0]["chain_in_arity"] >= 1
+
+
+@pytest.mark.slow
+def test_key_capacity_growth_recompile_cause(tmp_path):
+    """12 distinct keys against key_capacity=8 force exactly one 8->16
+    growth; the rebuild surfaces as exactly one recompile whose cause
+    is ``key_capacity_growth``, in both the series and the flight ring.
+    The first half of the stream stays under capacity so the growth
+    happens mid-job, AFTER the initial build — otherwise the very first
+    compile would absorb the growth and no recompile would exist.
+
+    Runs against a fresh per-test compilation cache: executing a
+    cache-deserialized executable with donated buffers segfaults
+    intermittently on this jax/XLA CPU build after a growth rebuild
+    (the long-standing reason growth tests live in the slow tier), and
+    a cold cache keeps the dispatch on the freshly-built in-memory
+    executable."""
+    import jax
+
+    prev_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "cc"))
+    try:
+        _growth_scenario()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache)
+
+
+def _growth_scenario():
+    lines = [
+        f"2020-01-01T00:{i // 60:02d}:{i % 60:02d} "
+        f"ch{i % (6 if i < 120 else 12)} 1234567"
+        for i in range(240)
+    ]
+    env, series = _run(lines, key_capacity=8)
+    assert _one(series, "operator_key_table_capacity")["value"] == 16
+    assert _one(series, "operator_compile_count")["value"] == 2
+    assert _one(series, "operator_recompile_count")["value"] == 1
+    (cause_s,) = [
+        s
+        for s in series[("operator_recompile_cause", "window")]
+        if s["labels"].get("cause") == "key_capacity_growth"
+    ]
+    assert cause_s["value"] == 1
+
+    events = env.metrics.job_obs.flight.dump()["events"]
+    compiled = [e for e in events if e["kind"] == "program_compiled"]
+    growth_compiles = [
+        e for e in compiled if e["cause"] == "key_capacity_growth"
+    ]
+    assert len(growth_compiles) == 1
+    # the growth flight event itself carries the cause too
+    grown = [e for e in events if e["kind"] == "key_capacity_grown"]
+    assert len(grown) == 1
+    assert grown[0]["cause"] == "key_capacity_growth"
+    assert grown[0]["new_capacity"] == 16

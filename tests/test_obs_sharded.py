@@ -123,6 +123,39 @@ def _run(parallelism):
     return env.metrics, sorted((t.f0, round(t.f1, 12)) for t in h.items)
 
 
+def test_hbm_state_bytes_shard_split_sums_to_single_chip_total():
+    """State-memory accounting is consistent across the mesh: the state
+    pytree is global, so the p=8 total equals the single-chip total
+    byte-for-byte, the per-shard attribution series sum back to it
+    exactly, and the shard label set / exchange staging gauge exist
+    only on the mesh."""
+
+    def _hbm(metrics):
+        total, shards, exchange = None, {}, None
+        for s in metrics.obs_snapshot()["metrics"]["series"]:
+            if s["name"] == "operator_hbm_state_bytes":
+                if "shard" in s["labels"]:
+                    shards[s["labels"]["shard"]] = s["value"]
+                else:
+                    total = s["value"]
+            elif s["name"] == "operator_exchange_buffer_bytes":
+                exchange = s["value"]
+        return total, shards, exchange
+
+    m1, out1 = _run(parallelism=1)
+    m8, out8 = _run(parallelism=8)
+    assert out1 == out8
+
+    tot1, shards1, ex1 = _hbm(m1)
+    tot8, shards8, ex8 = _hbm(m8)
+    assert tot1 > 0
+    assert shards1 == {} and ex1 is None  # single chip: no mesh series
+    assert tot8 == tot1
+    assert sorted(shards8) == [str(i) for i in range(8)]
+    assert sum(shards8.values()) == tot8
+    assert ex8 > 0
+
+
 def test_sharded_job_obs_matches_single_chip():
     m1, out1 = _run(parallelism=1)
     m8, out8 = _run(parallelism=8)

@@ -57,6 +57,16 @@ class ObsConfig:
     # callable(transition_dict) invoked on every health level change
     # (e.g. print, or append to an alerts file); exceptions swallowed.
 
+    # -- live scrape endpoint (obs/serve.py) --------------------------------
+    serve_port: Optional[int] = None
+    # None (default): no endpoint. >= 0: a background http.server daemon
+    # thread serves GET /metrics (Prometheus text), /healthz (HealthEngine
+    # levels; HTTP 503 while any rule is CRIT) and /snapshot.json for the
+    # life of the job; 0 binds an ephemeral port (JobObs.server.port).
+    serve_host: str = "127.0.0.1"
+    # bind address for the endpoint; loopback by default — exposing it
+    # beyond the host is an explicit decision
+
     # -- crash-dump flight recorder (obs/flightrecorder.py) -----------------
     flight_recorder: bool = True      # record runtime incidents (when
                                       # obs is enabled)

@@ -30,6 +30,18 @@ the TPU-runtime equivalent:
   runtime incidents (config, compiles, watermark jumps, stalls, rule
   transitions, the terminal exception) dumped as postmortem JSON on
   failure or on demand.
+* :mod:`tpustream.obs.compilation` — compile/recompile registry: every
+  XLA build of a program step is an explicit timed AOT compile with
+  cause attribution (``key_capacity_growth``, ``batch_shape_change``,
+  ``config_change``) and ``cost_analysis()``/``memory_analysis()``
+  gauges.
+* :mod:`tpustream.obs.memory` — HBM state-memory accounting: total and
+  per-shard ``hbm_state_bytes``, per-component state bytes, key-table
+  capacity/occupancy/load-factor, and key-cardinality / hot-key-skew
+  gauges.
+* :mod:`tpustream.obs.serve` — opt-in live scrape endpoint
+  (``ObsConfig.serve_port``): ``/metrics``, ``/healthz``,
+  ``/snapshot.json`` on a background daemon thread.
 * ``python -m tpustream.obs.dump <snapshot.json>`` — pretty-print a
   snapshot file (``--health`` evaluates rules offline, ``--selftest``
   is the CI smoke mode).
@@ -67,3 +79,6 @@ from .runtime import (  # noqa: F401
     NULL_OPERATOR_OBS,
     OperatorObs,
 )
+from .compilation import CompileObs, InstrumentedStep  # noqa: F401
+from .memory import StateMemoryTracker, leaf_nbytes  # noqa: F401
+from .serve import MetricsServer  # noqa: F401

@@ -197,7 +197,52 @@ def _selftest() -> int:
 
     text = render(snap)
     prom = snap["prometheus"]
+
+    # live-exposition round-trip: the same registry behind a real HTTP
+    # server on an ephemeral loopback port, scraped with urllib
+    import urllib.request
+
+    from .serve import MetricsServer
+
+    class _Provider:
+        health = engine
+
+        def to_prometheus_text(self):
+            return reg.to_prometheus_text()
+
+        def snapshot(self):
+            return job_snapshot(reg, meta={"job": "selftest"})
+
+    srv = MetricsServer(_Provider(), port=0)
+    srv.start()
+    try:
+        scraped = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=5
+        ).read().decode("utf-8")
+        served_snap = _json.loads(
+            urllib.request.urlopen(
+                srv.url + "/snapshot.json", timeout=5
+            ).read().decode("utf-8")
+        )
+        try:
+            hz = urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+            hz_code = hz.status
+        except urllib.error.HTTPError as e:  # crit -> 503 raises
+            hz_code = e.code
+    finally:
+        srv.close()
+
     checks = [
+        # vs a fresh render, not ``prom``: the health evaluation above
+        # minted series after that snapshot was taken
+        ("serve round-trips the exposition",
+         scraped == reg.to_prometheus_text()),
+        ("serve escapes the hostile label over HTTP",
+         'operator="he\\"llo\\\\wo\\nrld"' in scraped),
+        ("serve snapshot carries the series",
+         any(s["name"] == "records_in"
+             for s in served_snap["metrics"]["series"])),
+        ("healthz reflects the crit rule", hz_code == 503),
         ("render names the counter", "records_in" in text),
         ("render names the histogram", "e2e_latency_ms" in text),
         ("render includes health", "health: CRIT" in text),

@@ -1,0 +1,215 @@
+"""HBM state-memory accounting and key-skew gauges.
+
+The keyed programs hold ALL their state in one static-shaped pytree
+(``Runner.state``) — so "how much HBM does this job hold" is a walk over
+that tree's leaves (``shape x itemsize``, no device sync), and "what is
+it holding" is the program's own classification of its state keys into
+named components (pane rings, session cells, rolling planes, process
+buffers — see ``BaseProgram.state_components``).
+
+Per-operator series (labels ``{job, operator}``; all lazy ``set_fn``
+gauges, evaluated only at snapshot/scrape time):
+
+* ``operator_hbm_state_bytes``             — total state bytes
+* ``operator_hbm_state_bytes{shard=i}``    — per-shard attribution
+  (even split across the mesh: keyed leaves shard evenly on axis 0 and
+  replicated scalars are noise, so the per-shard series sum back to the
+  single-chip total exactly)
+* ``operator_state_component_bytes{component=...}``
+* ``operator_exchange_buffer_bytes``       — keyBy all_to_all staging
+* ``operator_key_table_capacity`` / ``_occupancy`` / ``_load_factor``
+* ``operator_key_cardinality``             — distinct keys seen
+* ``operator_hot_key_share``               — top key's share of keyed
+  updates (NaN until any update lands); ``operator_hot_key_id`` names it
+* ``operator_key_updates`` (counter)       — keyed rows observed
+
+Skew tracking is host-side and obs-gated: one ``np.bincount`` over the
+batch's key-id column per feed (interned ids are dense ``< capacity``),
+never per-record Python. Raw int64 key columns whose ids exceed the
+tracking bound disable skew gauges for the runner (one flight event)
+rather than growing an unbounded count table.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+# ids beyond this are not dense interned ids (raw i64 key column):
+# tracking them per-id would be unbounded, so skew tracking opts out
+MAX_TRACKED_KEY_ID = 1 << 22
+
+
+def leaf_nbytes(leaf) -> int:
+    """Array bytes from metadata only — never forces a device sync."""
+    nb = getattr(leaf, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(math.prod(shape)) * np.dtype(dtype).itemsize
+
+
+class StateMemoryTracker:
+    """Installs the memory/skew gauges for one runner and accumulates
+    per-key update counts from the feed path."""
+
+    def __init__(self, runner):
+        self._runner = runner
+        obs = runner.obs
+        self._counts = np.zeros(0, dtype=np.int64)
+        self._updates = 0
+        self._skew_disabled = False
+
+        obs.gauge("hbm_state_bytes").set_fn(self.total_bytes)
+        shards = runner.program.n_shards
+        if shards > 1:
+            for i in range(shards):
+                obs.scoped(shard=str(i)).gauge(
+                    "operator_hbm_state_bytes"
+                ).set_fn(lambda s=shards: self.total_bytes() / s)
+            obs.gauge("exchange_buffer_bytes").set_fn(self.exchange_bytes)
+        for comp in self._component_names():
+            obs.scoped(component=comp).gauge(
+                "operator_state_component_bytes"
+            ).set_fn(lambda c=comp: self.component_bytes().get(c, 0))
+
+        if runner.plan.key_pos is not None:
+            obs.gauge("key_table_capacity").set_fn(
+                lambda: self._runner.cfg.key_capacity
+            )
+            obs.gauge("key_table_occupancy").set_fn(self.occupancy)
+            obs.gauge("key_table_load_factor").set_fn(self.load_factor)
+            obs.gauge("key_cardinality").set_fn(self.cardinality)
+            obs.gauge("hot_key_share").set_fn(self.hot_key_share)
+            obs.gauge("hot_key_id").set_fn(self.hot_key_id)
+            self._updates_counter = obs.counter("key_updates")
+        else:
+            self._updates_counter = None
+
+    # -- state walk ---------------------------------------------------------
+
+    def _state_items(self):
+        state = self._runner.state
+        if isinstance(state, dict):
+            return state.items()
+        return ()
+
+    def total_bytes(self) -> int:
+        import jax
+
+        return sum(
+            leaf_nbytes(l)
+            for l in jax.tree_util.tree_leaves(self._runner.state)
+        )
+
+    def component_bytes(self) -> dict:
+        import jax
+
+        comp_of = self._runner.program.state_components()
+        out: dict = {}
+        for key, entry in self._state_items():
+            comp = comp_of.get(key, "scalars")
+            nb = sum(
+                leaf_nbytes(l) for l in jax.tree_util.tree_leaves(entry)
+            )
+            out[comp] = out.get(comp, 0) + nb
+        return out
+
+    def _component_names(self):
+        comp_of = self._runner.program.state_components()
+        names = set(comp_of.values())
+        names.add("scalars")
+        return sorted(names)
+
+    def exchange_bytes(self) -> int:
+        """Footprint of the keyBy all_to_all staging buffers: the
+        ``[n_shards * capacity]`` post-exchange columns (+ ts + valid)
+        each sharded step materializes."""
+        from ..parallel.exchange import exchange_buffer_bytes
+
+        prog = self._runner.program
+        kinds = getattr(
+            getattr(prog, "pre_chain", None), "out_kinds", None
+        ) or self._runner.plan.record_kinds
+        return exchange_buffer_bytes(
+            prog.n_shards, getattr(prog, "exchange_capacity", 0), kinds
+        )
+
+    # -- key table ----------------------------------------------------------
+
+    def _key_table(self):
+        r = self._runner
+        if r.plan.key_pos is None:
+            return None
+        if r.plan.synthetic_key:
+            return r.plan.tables[-1] if r.plan.tables else None
+        return r.program.pre_chain.out_tables[r.plan.key_pos]
+
+    def occupancy(self) -> Optional[int]:
+        t = self._key_table()
+        if t is not None:
+            return len(t)
+        # raw integer keys have no intern table: distinct ids seen so far
+        return int((self._counts > 0).sum()) if self._updates else 0
+
+    def load_factor(self) -> float:
+        occ = self.occupancy() or 0
+        cap = self._runner.cfg.key_capacity
+        return occ / cap if cap else 0.0
+
+    def cardinality(self) -> Optional[int]:
+        return self.occupancy()
+
+    # -- skew ---------------------------------------------------------------
+
+    def observe_batch(self, batch) -> None:
+        """Accumulate per-key update counts from one (pre-split) feed
+        batch: one vectorized bincount over the key-id column."""
+        if self._skew_disabled:
+            return
+        r = self._runner
+        pos = r.plan.key_pos
+        if pos is None or pos >= len(batch.columns):
+            return
+        ids = np.asarray(batch.columns[pos].data)
+        if ids.dtype.kind not in "iu":
+            return
+        valid = np.asarray(batch.valid)
+        if valid.shape == ids.shape and not valid.all():
+            ids = ids[valid]
+        if ids.size == 0:
+            return
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= MAX_TRACKED_KEY_ID:
+            self._skew_disabled = True
+            r._flight.record(
+                "key_skew_tracking_disabled",
+                operator=r.obs.name,
+                reason=f"key id out of tracked range [0, {MAX_TRACKED_KEY_ID})",
+                observed=hi if lo >= 0 else lo,
+            )
+            return
+        counts = np.bincount(ids, minlength=self._counts.shape[0])
+        if counts.shape[0] > self._counts.shape[0]:
+            counts[: self._counts.shape[0]] += self._counts
+            self._counts = counts
+        else:
+            self._counts += counts
+        self._updates += int(ids.size)
+        if self._updates_counter is not None:
+            self._updates_counter.inc(int(ids.size))
+
+    def hot_key_share(self) -> float:
+        if self._updates == 0:
+            return float("nan")
+        return float(self._counts.max()) / float(self._updates)
+
+    def hot_key_id(self) -> float:
+        if self._updates == 0:
+            return float("nan")
+        return int(self._counts.argmax())

@@ -62,16 +62,25 @@ class Counter:
 class Gauge:
     """Last-write-wins scalar; ``set_fn`` installs a pull callback
     evaluated at snapshot time (queue depths, live state reads) so the
-    hot path never pays for it."""
+    hot path never pays for it.
+
+    A raising callback must never abort a snapshot or a live scrape: the
+    error is counted in a ``gauge_callback_errors`` series (labelled with
+    the failing gauge's name), logged ONCE to the registry's flight
+    recorder, and the gauge reads NaN until the callback recovers — a
+    visible hole in the series instead of a silently frozen stale value.
+    """
 
     kind = "gauge"
-    __slots__ = ("name", "labels", "_value", "_fn")
+    __slots__ = ("name", "labels", "_value", "_fn", "_registry", "_errored")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
         self.labels = dict(labels)
         self._value: float = 0.0
         self._fn: Optional[Callable[[], Optional[float]]] = None
+        self._registry: Optional["MetricsRegistry"] = None
+        self._errored = False
 
     def set(self, v) -> None:
         self._value = v
@@ -84,11 +93,28 @@ class Gauge:
         if self._fn is not None:
             try:
                 v = self._fn()
-            except Exception:
-                v = None
+            except Exception as e:
+                self._on_callback_error(e)
+                return float("nan")
             if v is not None:
                 self._value = v
         return self._value
+
+    def _on_callback_error(self, exc: BaseException) -> None:
+        reg = self._registry
+        if reg is not None:
+            labels = dict(self.labels)
+            labels["gauge"] = self.name
+            reg._series(Counter, "gauge_callback_errors", labels).inc()
+            flight = getattr(reg, "flight", None)
+            if flight is not None and not self._errored:
+                flight.record(
+                    "gauge_callback_error",
+                    gauge=self.name,
+                    labels=dict(self.labels),
+                    error=repr(exc),
+                )
+        self._errored = True
 
     def snapshot_value(self):
         return self.value
@@ -247,6 +273,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._by_key: Dict[Tuple[str, LabelKey], object] = {}
+        # optional FlightRecorder (installed by JobObs) so instrument
+        # error paths can leave a breadcrumb without an import cycle
+        self.flight = None
 
     def group(self, **labels) -> MetricGroup:
         return MetricGroup(self, {k: str(v) for k, v in labels.items()})
@@ -256,6 +285,8 @@ class MetricsRegistry:
         inst = self._by_key.get(key)
         if inst is None:
             inst = cls(name, labels, **kw)
+            if cls is Gauge:
+                inst._registry = self
             self._by_key[key] = inst
         elif not isinstance(inst, cls):
             raise TypeError(
@@ -265,7 +296,10 @@ class MetricsRegistry:
         return inst
 
     def series(self) -> List[object]:
-        return [self._by_key[k] for k in sorted(self._by_key)]
+        # list() first: the serve thread renders while the executor (or a
+        # gauge error path) mints series; CPython's list(dict) is atomic,
+        # a plain iteration over the dict is not
+        return [self._by_key[k] for k in sorted(list(self._by_key))]
 
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold another registry's series into this one, loss-free for

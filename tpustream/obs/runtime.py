@@ -54,6 +54,14 @@ class OperatorObs:
             self._group.group(shard=str(index)), self.tracer, self._hist_samples
         )
 
+    def scoped(self, **labels):
+        """Raw label sub-scope under this operator (``cause=...``,
+        ``component=...``, ``shard=...``) for series that need an extra
+        dimension without minting a whole instrument bundle. Instrument
+        names are NOT auto-prefixed here — callers pass the full
+        ``operator_*`` name."""
+        return self._group.group(**labels)
+
     def counter(self, name: str):
         return self._group.counter("operator_" + name)
 
@@ -117,6 +125,27 @@ class JobObs:
             else None
         )
         self.snapshotter.health_engine = self.health
+        # gauge callback errors leave a (once-per-gauge) breadcrumb
+        self.registry.flight = self.flight
+
+        # live scrape endpoint (obs/serve.py): /metrics + /healthz +
+        # /snapshot.json on a daemon thread, ephemeral port when 0
+        self.server = None
+        serve_port = getattr(cfg, "serve_port", None)
+        if serve_port is not None and int(serve_port) >= 0:
+            from .serve import MetricsServer
+
+            self.server = MetricsServer(
+                self,
+                port=int(serve_port),
+                host=getattr(cfg, "serve_host", "127.0.0.1"),
+                flight=self.flight,
+            ).start()
+            self.flight.record(
+                "serve_started",
+                host=self.server.host,
+                port=self.server.port,
+            )
         self._closed = False
 
     def operator(self, name: str) -> OperatorObs:
@@ -168,6 +197,11 @@ class JobObs:
         if self._closed:
             return None
         self._closed = True
+        if self.server is not None:
+            # stop the scrape endpoint FIRST: the final snapshot below is
+            # then the authoritative last word, and no socket outlives
+            # the job
+            self.server.close()
         snap = self.snapshotter.close()
         dump_path = None
         if self.flight.enabled and (failed or self.flight_dump_path):
@@ -188,6 +222,27 @@ class JobObs:
         self.close(failed=True)
 
 
+class _NullGroup:
+    """Disabled twin of MetricGroup: every mint is the null instrument."""
+
+    __slots__ = ()
+
+    def group(self, **labels):
+        return self
+
+    def counter(self, name: str):
+        return NULL_COUNTER
+
+    def gauge(self, name: str):
+        return NULL_GAUGE
+
+    def histogram(self, name: str, max_samples: int = 0):
+        return NULL_HISTOGRAM
+
+
+NULL_GROUP = _NullGroup()
+
+
 class _NullOperatorObs:
     enabled = False
     name = ""
@@ -203,6 +258,9 @@ class _NullOperatorObs:
 
     def shard(self, index):
         return self
+
+    def scoped(self, **labels):
+        return NULL_GROUP
 
     def counter(self, name: str):
         return NULL_COUNTER
@@ -229,6 +287,7 @@ class _NullJobObs:
     flight = NULL_FLIGHT
     health = None
     flight_dump_path = ""
+    server = None
 
     __slots__ = ()
 

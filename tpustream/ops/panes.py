@@ -30,6 +30,11 @@ import jax.numpy as jnp
 
 W0 = -(2**62)  # "long min" safe against offset arithmetic
 
+# state-dict keys of the pane-ring layout (accumulator planes, per-cell
+# element counts, the slot->pane mapping), for the obs/memory.py
+# component accounting
+PANE_RING_STATE_KEYS = ("planes", "cnt", "slot_pane")
+
 
 class RingSpec(NamedTuple):
     pane_ms: int          # pane granularity g

@@ -38,6 +38,11 @@ from .wordplanes import (
 )
 
 
+# state-dict keys this module owns, for the obs/memory.py component
+# accounting: the dense per-key storage planes plus the occupancy bitmap
+ROLLING_STATE_KEYS = ("seen", "planes")
+
+
 def init_rolling_state(
     key_capacity: int,
     kinds: List[str],

@@ -29,6 +29,14 @@ import jax.numpy as jnp
 
 from .panes import RingSpec, W0
 
+# state-dict keys of the session-cell layout (typed [keys, slots]
+# accumulators, per-cell element counts and min/max timestamps, fired /
+# pending flags), for the obs/memory.py component accounting
+SESSION_CELL_STATE_KEYS = (
+    "acc", "cnt", "cell_min", "cell_max", "cell_fired",
+    "pending_mark", "pending_clear",
+)
+
 TS_MAX = 2**62  # empty-cell sentinel for per-cell min timestamp
 
 

@@ -36,6 +36,24 @@ def exchange_capacity(
     return min(local_b, max(1, math.ceil(local_b / n_shards * capacity_factor)))
 
 
+# kind -> bytes per element of the post-exchange staging columns
+# (STR columns travel as interned int32 ids)
+_KIND_ITEMSIZE = {"f64": 8, "i64": 8, "bool": 1, "str": 4}
+
+
+def exchange_buffer_bytes(
+    n_shards: int, capacity: int, col_kinds
+) -> int:
+    """Bytes the keyBy all_to_all stages per step and per shard: one
+    ``[n_shards * capacity]`` post-exchange buffer per record column,
+    plus the int64 timestamps and the bool valid mask. Shared with the
+    obs/memory.py accounting gauge so the reported footprint and the
+    shapes the sharded step actually materializes never drift."""
+    rows = n_shards * capacity
+    per_row = sum(_KIND_ITEMSIZE.get(k, 8) for k in col_kinds)
+    return rows * (per_row + 8 + 1)  # + ts (int64) + valid (bool)
+
+
 def exchange_by_key(
     cols: List[jnp.ndarray],
     valid: jnp.ndarray,

@@ -46,6 +46,8 @@ class CountWindowProgram(WindowProgram):
     fires_on_clock = False
     main_emission_prefix = False  # emissions ride the sorted batch order
     operator_name = "count_window"
+    # no pane ring: count state is per-key accumulators + open counts
+    STATE_COMPONENT_KEYS = {"count_acc": ("acc", "cnt")}
 
     def __init__(self, plan: JobPlan, cfg):
         BaseProgram.__init__(self, plan, cfg)
@@ -175,6 +177,9 @@ class _ElementLogMixin:
     ``slide``-th element of a key and sees the most recent
     ``min(size, seen)`` elements in arrival order.
     """
+
+    # the circular element log dominates these variants' footprint
+    STATE_COMPONENT_KEYS = {"element_log": ("ebuf", "tot")}
 
     def _sorted_batch(self, state, keys, mask):
         """Sort the batch by key and derive each record's global per-key

@@ -147,6 +147,20 @@ class DeviceChain:
     def out_arity(self) -> int:
         return len(self.out_kinds)
 
+    def describe(self) -> dict:
+        """Static trace-complexity summary for the compile registry:
+        every op in this chain inlines into the program's single XLA
+        step, so op count and arities are the knobs that move its
+        compile time and flops."""
+        n_map = sum(1 for op, _ in self.ops if op == "map")
+        return {
+            "chain_ops": len(self.ops),
+            "chain_map_ops": n_map,
+            "chain_filter_ops": len(self.ops) - n_map,
+            "chain_in_arity": len(self.in_kinds),
+            "chain_out_arity": len(self.out_kinds),
+        }
+
     def apply(self, cols: Sequence[Any], mask):
         """Vectorized over the batch: cols are [B] arrays, mask bool[B]."""
         if not self.ops:

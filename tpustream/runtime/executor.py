@@ -388,6 +388,11 @@ class Runner:
         # makes every obs call below a no-op attribute call.
         self.obs = metrics.job_obs.operator(self.program.operator_name)
         self._step_idx = 0
+        # why the NEXT _counted_step build happens (obs/compilation.py
+        # causes); rebuild sites overwrite this before nulling self.step
+        self._recompile_cause = "initial"
+        self._compile_obs = None
+        self._state_mem = None
         # H2D transfer compression: int64 columns and timestamps ship as
         # int32 deltas against a per-batch base scalar (lossless) and
         # re-expand on device — on the PCIe/host link these columns are
@@ -484,6 +489,23 @@ class Runner:
         # and strict_overflow never fails on pre-snapshot loss)
         self._counter_baseline: Dict[str, int] = {}
         if self.obs.enabled:
+            from ..obs.compilation import CompileObs
+            from ..obs.memory import StateMemoryTracker
+
+            # compile/recompile registry: _counted_step routes its jit
+            # through a timed AOT build so wall time / cost analysis /
+            # cause land in the registry before the first dispatch
+            self._compile_obs = CompileObs(
+                self.obs,
+                self._flight,
+                meta=getattr(
+                    getattr(self.program, "pre_chain", None),
+                    "describe",
+                    dict,
+                )(),
+            )
+            # HBM state accounting + key-cardinality/skew gauges
+            self._state_mem = StateMemoryTracker(self)
             # pull-style backpressure gauge: chain hand-off rows parked
             # between pumps, read only at snapshot time
             self.obs.gauge("chain_buffer_entries").set_fn(
@@ -562,7 +584,11 @@ class Runner:
                 cap *= 2
             self._grow_key_capacity(cap)
 
-    def _grow_key_capacity(self, new_capacity: Optional[int] = None):
+    def _grow_key_capacity(
+        self,
+        new_capacity: Optional[int] = None,
+        cause: str = "key_capacity_growth",
+    ):
         """Rebuild the program at ``new_capacity`` (default 2x) and
         migrate device state: key-sharded leaves block-copy into the
         head of each shard's larger region (interned ids are stable and
@@ -584,6 +610,7 @@ class Runner:
             operator=self.obs.name or self.program.operator_name,
             old_capacity=self.cfg.key_capacity,
             new_capacity=new_cap,
+            cause=cause,
         )
         old_prog = self.program
         # key-sharded leaves fetch LOCAL shards only (the migration is
@@ -604,6 +631,7 @@ class Runner:
             if getattr(old_prog, flag, False):
                 setattr(self.program, flag, True)
         self._inner_step = self.program.jitted_step()
+        self._recompile_cause = cause
         self.step = None
         self._empty_cache = None
         target = self.program.init_state()
@@ -711,6 +739,7 @@ class Runner:
         ts_p, ts_b, ts_m = pack_one(ts, self._ts_mode)
         if tuple(modes) != self._col_modes or ts_m != self._ts_mode:
             self._col_modes, self._ts_mode = tuple(modes), ts_m
+            self._recompile_cause = "batch_shape_change"
             self.step = None  # rebuild for the demoted layout
             self._empty_cache = None
             return self._pack(cols, valid, ts)
@@ -774,6 +803,8 @@ class Runner:
         if markers:
             self._pending_markers.extend(markers)
         self._check_capacity()
+        if self._state_mem is not None:
+            self._state_mem.observe_batch(batch)
         if t_batch is None:
             t_batch = time.perf_counter()
         for start in range(0, batch.n, cfg.batch_size):
@@ -858,6 +889,13 @@ class Runner:
                     counts[name] = stream["fire"].sum(dtype=jnp.int32)
             return state, em, counts
 
+        if self._compile_obs is not None:
+            cause = self._recompile_cause
+            # any later miss inside this step object is shape-driven
+            self._recompile_cause = "batch_shape_change"
+            return self._compile_obs.instrument(
+                step, cause=cause, donate_argnums=0
+            )
         return jax.jit(step, donate_argnums=0)
 
     def _run_step(self, inputs, wm_lower: int, t_batch=None):
@@ -1841,7 +1879,7 @@ def _execute_job(env, sink_nodes) -> JobResult:
         # headroom into repeated re-growth.)
         for r, cap in zip(stages, ck.key_capacities or []):
             if cap and cap > r.cfg.key_capacity:
-                r._grow_key_capacity(cap)
+                r._grow_key_capacity(cap, cause="config_change")
         # computed-KeySelector chain stages intern into runtime-built
         # DerivedKeyTables — reload their snapshots so saved state rows
         # keep their key ids

@@ -67,6 +67,11 @@ class ProcessWindowProgram(WindowProgram):
     # buffers, so emissions cannot outlive the step that produced them
     emissions_reference_state = True
     operator_name = "process_window"
+    # raw element buffers replace the word-plane accumulators
+    STATE_COMPONENT_KEYS = {
+        "process_buffers": ("buf", "cnt"),
+        "pane_ring": ("slot_pane",),
+    }
 
     def _build_agg(self) -> None:
         # no incremental aggregation: accumulators ARE the element buffers

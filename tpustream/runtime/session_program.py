@@ -51,6 +51,11 @@ from .window_program import WindowProgram
 class SessionWindowProgram(WindowProgram):
     accepted_kinds = ("session",)
     operator_name = "session_window"
+    # per-cell min/max/fired ride next to the typed accumulators
+    STATE_COMPONENT_KEYS = {
+        "session_cells": sess_ops.SESSION_CELL_STATE_KEYS,
+        "pane_ring": ("slot_pane",),
+    }
 
     def __init__(self, plan: JobPlan, cfg):
         st = plan.stateful
@@ -626,6 +631,15 @@ class SessionProcessProgram(ProcessWindowProgram):
     operator_name = "session_process"
 
     accepted_kinds = ("session",)
+
+    STATE_COMPONENT_KEYS = {
+        "process_buffers": ("buf", "cnt"),
+        "pane_ring": ("slot_pane",),
+        "session_cells": (
+            "cell_min", "cell_max", "cell_fired",
+            "pending_mark", "pending_clear",
+        ),
+    }
 
     def _make_ring(self, spec, cfg):
         return pane_ops.make_ring_spec(

@@ -176,6 +176,21 @@ class BaseProgram:
             if n in state and getattr(state[n], "ndim", None) == 0
         }
 
+    # state-dict keys grouped into named memory components for the
+    # obs/memory.py HBM accounting (component -> tuple of state keys);
+    # each program family claims its big array leaves, everything
+    # unclaimed (counters, clocks) reports under "scalars"
+    STATE_COMPONENT_KEYS: dict = {}
+
+    def state_components(self) -> dict:
+        """Flat ``state key -> component name`` map derived from
+        :data:`STATE_COMPONENT_KEYS`."""
+        out = {}
+        for comp, keys in self.STATE_COMPONENT_KEYS.items():
+            for k in keys:
+                out[k] = comp
+        return out
+
     # False for programs with no time semantics (per-record rolling,
     # count windows, stateless chains): a clock tick / EOS flush step can
     # never produce output for them, so the executor skips it
@@ -276,6 +291,7 @@ class RollingProgram(BaseProgram):
 
     fires_on_clock = False
     operator_name = "rolling"
+    STATE_COMPONENT_KEYS = {"rolling_planes": rolling_ops.ROLLING_STATE_KEYS}
 
     def __init__(self, plan: JobPlan, cfg: StreamConfig):
         super().__init__(plan, cfg)

@@ -65,6 +65,7 @@ def _dummy_scalar(kind: str):
 
 
 class WindowProgram(BaseProgram):
+    STATE_COMPONENT_KEYS = {"pane_ring": pane_ops.PANE_RING_STATE_KEYS}
     accepted_kinds = ("tumbling", "sliding")
     main_emission_prefix = True  # append-compacted alert buffer
     operator_name = "window"
