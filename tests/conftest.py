@@ -90,6 +90,10 @@ _SLOW_FILES = {
     # reliably in a fresh process, so it runs in the full gate tier
     # where a dedicated run can host it.
     "test_key_growth.py",
+    # sharded/soak supervised-recovery matrix (p=8 meshes, multi-fault
+    # soak); the fast deterministic recovery tests stay tier-1 in
+    # test_recovery.py
+    "test_recovery_sharded.py",
 }
 # individual slow tests inside otherwise-fast files
 _SLOW_TESTS = {

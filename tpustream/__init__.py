@@ -45,6 +45,7 @@ from .api.functions import (  # noqa: E402
 )
 from .api.output import OutputTag  # noqa: E402
 from .config import StreamConfig  # noqa: E402
+from .runtime.supervisor import RestartStrategies  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -58,6 +59,7 @@ __all__ = [
     "OutputTag",
     "ProcessWindowFunction",
     "ReduceFunction",
+    "RestartStrategies",
     "StreamConfig",
     "StreamExecutionEnvironment",
     "Time",

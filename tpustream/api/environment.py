@@ -24,6 +24,11 @@ class StreamExecutionEnvironment:
         self.job_name: Optional[str] = None
         self.metrics = None        # populated by execute()
         self._checkpoint_restore_path: Optional[str] = None
+        # dead-letter output (StreamConfig.dead_letter): (line, error)
+        # pairs quarantined by the host parse stage instead of failing
+        # the job; survives supervised restarts (rolled back with the
+        # sinks on recovery so counts stay exactly-once)
+        self.dead_letters: list = []
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -57,6 +62,15 @@ class StreamExecutionEnvironment:
 
     def restore_from_checkpoint(self, path: str) -> None:
         self._checkpoint_restore_path = path
+
+    def set_restart_strategy(self, strategy) -> None:
+        """Flink 1.8 parity (env.setRestartStrategy(
+        RestartStrategies.fixedDelayRestart(3, ...))): failures consult
+        ``strategy`` and restarts resume from the latest checkpoint —
+        see runtime/supervisor.py and docs/recovery.md."""
+        self.config = self.config.replace(restart_strategy=strategy)
+
+    setRestartStrategy = set_restart_strategy
 
     # -- sources -------------------------------------------------------------
     def socket_text_stream(

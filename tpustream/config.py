@@ -142,6 +142,37 @@ class StreamConfig:
     # overflow is then counted in state["exchange_overflow"].
 
     # -- failure policy -----------------------------------------------------
+    restart_strategy: Optional[object] = None
+    # A runtime.supervisor.RestartStrategy (fixed_delay / failure_rate /
+    # no_restart — Flink 1.8's RestartStrategies surface, also settable
+    # via StreamExecutionEnvironment.set_restart_strategy). None
+    # (default) = unsupervised: the first failure propagates, exactly
+    # as before this knob existed. Set, execute_job runs under
+    # runtime/supervisor.py: failures consult the strategy and a
+    # restart rebuilds the runner chain and resumes exactly-once from
+    # the latest valid checkpoint (or from scratch when none exists).
+    # Requires a replayable source (ReplaySource family).
+
+    dead_letter: bool = False
+    # Data-plane graceful degradation: lines that fail parsing or
+    # timestamp extraction are quarantined to env.dead_letters (the
+    # dead-letter output, (line, error) pairs) and counted in
+    # records_quarantined instead of failing the job. Default False
+    # preserves fail-fast semantics. Quarantine probing re-runs the
+    # host parse per line on a failed batch — the slow path costs only
+    # on batches that actually contain poison.
+    dead_letter_capacity: int = 65536
+    # retained dead-letter records; past it lines are dropped after
+    # counting (the counter stays exact)
+
+    sink_retries: int = 0
+    # Sink emit failures retry this many times with capped exponential
+    # backoff before escalating to the supervisor (0 = escalate
+    # immediately). Applies per emit call.
+    sink_retry_base_ms: float = 10.0
+    sink_retry_max_ms: float = 1000.0
+    # backoff delay: min(base * 2^attempt, max) milliseconds
+
     strict_overflow: bool = False
     # When True the job FAILS (RuntimeError at flush / end of stream)
     # if any lossy counter went nonzero: exchange_overflow (keyBy shuffle

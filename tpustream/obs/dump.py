@@ -172,6 +172,13 @@ def _selftest() -> int:
     h = g.histogram("e2e_latency_ms")
     for v in (1.0, 2.0, 5.0, 10.0):
         h.observe(v)
+    # supervised-recovery series (docs/recovery.md): snapshot cost
+    # histograms + the per-cause restart counter
+    cs = g.histogram("checkpoint_save_ms")
+    for v in (2.5, 3.5):
+        cs.observe(v)
+    g.histogram("checkpoint_bytes").observe(8192.0)
+    g.group(cause="device_step").counter("job_restarts_total").inc(2)
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -245,6 +252,10 @@ def _selftest() -> int:
         ("healthz reflects the crit rule", hz_code == 503),
         ("render names the counter", "records_in" in text),
         ("render names the histogram", "e2e_latency_ms" in text),
+        ("render names the checkpoint cost histograms",
+         "checkpoint_save_ms" in text and "checkpoint_bytes" in text),
+        ("prometheus carries the restart cause label",
+         "job_restarts_total" in prom and 'cause="device_step"' in prom),
         ("render includes health", "health: CRIT" in text),
         ("prometheus escapes the hostile label",
          'operator="he\\"llo\\\\wo\\nrld"' in prom),

@@ -84,7 +84,8 @@ class JobObs:
     enabled = True
 
     def __init__(self, obs_cfg=None, job_name: str = "job",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 flight: Optional[FlightRecorder] = None):
         cfg = obs_cfg
         trace = getattr(cfg, "trace", True)
         ring = getattr(cfg, "trace_ring_size", 4096)
@@ -103,8 +104,11 @@ class JobObs:
         )
         self._op_names: dict = {}
 
-        # crash-dump flight recorder (obs/flightrecorder.py)
-        self.flight = (
+        # crash-dump flight recorder (obs/flightrecorder.py); a
+        # supervised job passes ONE recorder through every restart
+        # attempt so the postmortem ring spans failure -> restart ->
+        # restored, not just the last attempt
+        self.flight = flight if flight is not None else (
             FlightRecorder(getattr(cfg, "flight_ring_size", 512))
             if getattr(cfg, "flight_recorder", True)
             else NULL_FLIGHT
@@ -165,6 +169,9 @@ class JobObs:
     def gauge(self, name: str):
         return self.group.gauge(name)
 
+    def histogram(self, name: str):
+        return self.group.histogram(name, max_samples=self.hist_samples)
+
     def maybe_snapshot(self):
         return self.snapshotter.maybe_snapshot()
 
@@ -188,12 +195,14 @@ class JobObs:
             os.getcwd(), f"tpustream-flight-{os.getpid()}.json"
         )
 
-    def close(self, failed: bool = False) -> Optional[dict]:
+    def close(self, failed: bool = False, dump: bool = True) -> Optional[dict]:
         """Terminal flush: one final snapshot (with the health engine's
         last word) and — on failure, or whenever a dump path was
         configured — the flight-recorder postmortem JSON. Idempotent, so
         the failure wrapper and a user-level ``finally`` can both call
-        it."""
+        it. ``dump=False`` skips the postmortem write (a supervised
+        attempt that may restart defers the dump to the supervisor's
+        terminal decision)."""
         if self._closed:
             return None
         self._closed = True
@@ -204,7 +213,7 @@ class JobObs:
             self.server.close()
         snap = self.snapshotter.close()
         dump_path = None
-        if self.flight.enabled and (failed or self.flight_dump_path):
+        if self.flight.enabled and dump and (failed or self.flight_dump_path):
             dump_path = self._default_dump_path()
             try:
                 self.flight.write(
@@ -215,11 +224,13 @@ class JobObs:
                 dump_path = None
         return {"snapshot": snap, "flight_dump_path": dump_path}
 
-    def on_failure(self, exc: BaseException, operator: str = "") -> None:
+    def on_failure(
+        self, exc: BaseException, operator: str = "", dump: bool = True
+    ) -> None:
         """Record the terminal exception (with the operator that was
         active) and write the postmortem bundle."""
         self.flight.record_exception(exc, operator)
-        self.close(failed=True)
+        self.close(failed=True, dump=dump)
 
 
 class _NullGroup:
@@ -300,6 +311,9 @@ class _NullJobObs:
     def gauge(self, name: str):
         return NULL_GAUGE
 
+    def histogram(self, name: str):
+        return NULL_HISTOGRAM
+
     def maybe_snapshot(self):
         return None
 
@@ -309,10 +323,12 @@ class _NullJobObs:
     def to_prometheus_text(self) -> str:
         return ""
 
-    def close(self, failed: bool = False):
+    def close(self, failed: bool = False, dump: bool = True):
         return None
 
-    def on_failure(self, exc: BaseException, operator: str = "") -> None:
+    def on_failure(
+        self, exc: BaseException, operator: str = "", dump: bool = True
+    ) -> None:
         pass
 
 

@@ -48,8 +48,29 @@ import numpy as np
 # with the version message instead of a leaf-shape ValueError);
 # DerivedKeyTable reserves id 0 as the filter-drop placeholder, shifting
 # every derived key id by one
-FORMAT_VERSION = 7
+# v8: supervised recovery (runtime/supervisor.py) — meta gains a payload
+# checksum (load/validate detect corruption), absolute collect-sink
+# counts + quarantined dead-letter count at snapshot time (the restore
+# rollback that makes an in-process restart's output byte-identical to
+# an uninterrupted run), and the writing supervision session's nonce;
+# snapshots are now named by source position (monotone across restart
+# attempts, where the per-attempt batch counter is not)
+FORMAT_VERSION = 8
 _META_KEY = "__meta__"
+
+
+def _checksum(leaves: List[np.ndarray]) -> int:
+    """CRC32 chained over every leaf's dtype/shape/bytes — cheap enough
+    to run on each save, strong enough to catch the torn/overwritten
+    payloads a crashed writer or bad disk leaves behind."""
+    import zlib
+
+    c = 0
+    for l in leaves:
+        a = np.ascontiguousarray(l)
+        c = zlib.crc32(str((a.dtype.str, a.shape)).encode(), c)
+        c = zlib.crc32(a.tobytes(), c)
+    return c & 0xFFFFFFFF
 
 
 def _leaves(state) -> List[np.ndarray]:
@@ -97,6 +118,18 @@ class Checkpoint:
     # tables are built at runtime, so without this a resumed run would
     # re-intern only post-snapshot keys and mis-map saved state rows.
     chain_key_tables: Optional[list] = None
+    # absolute collect-sink lengths at snapshot time, in sink-node
+    # order (None per non-collect sink): a supervised in-process
+    # restart truncates each handle back to these before replaying, so
+    # the recovered output is byte-identical to an uninterrupted run
+    sink_counts: Optional[list] = None
+    # dead-letter records quarantined before this snapshot (same
+    # rollback, for env.dead_letters)
+    quarantined: int = 0
+    # nonce of the supervision session that wrote the snapshot; the
+    # rollback above only applies when it matches the restoring
+    # session (a pre-session snapshot predates this process's output)
+    session: Optional[str] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -256,10 +289,20 @@ def save_checkpoint(
     lazy_schemas: Optional[list] = None,
     key_capacities: Optional[list] = None,
     chain_key_tables: Optional[list] = None,
+    sink_counts: Optional[list] = None,
+    quarantined: int = 0,
+    session: Optional[str] = None,
 ) -> str:
-    """Snapshot to ``directory/ckpt-<batches>.npz`` (atomic rename); prunes
-    to the ``keep`` newest snapshots and refreshes ``latest`` marker."""
+    """Snapshot to ``directory/ckpt-<source_pos>.npz`` (atomic
+    write-to-.tmp + ``os.replace``); prunes to the ``keep`` newest
+    snapshots and refreshes the ``latest`` marker. Named by source
+    position because restart attempts reset the batch counter: the name
+    order must stay monotone with stream progress across attempts so
+    pruning and the sorted-glob fallback never prefer a stale snapshot.
+    A re-save at the same position (processing-time advancement without
+    new lines) atomically replaces the older file."""
     os.makedirs(directory, exist_ok=True)
+    leaves = _leaves(state)
     meta = {
         "version": FORMAT_VERSION,
         "record_kinds": list(plan.record_kinds),
@@ -275,9 +318,13 @@ def save_checkpoint(
         "lazy_schemas": lazy_schemas or [],
         "key_capacities": list(key_capacities or []),
         "chain_key_tables": list(chain_key_tables or []),
+        "sink_counts": list(sink_counts) if sink_counts is not None else None,
+        "quarantined": int(quarantined),
+        "session": session,
+        "checksum": _checksum(leaves),
     }
-    arrays = {f"L{i:04d}": l for i, l in enumerate(_leaves(state))}
-    name = f"ckpt-{batches:010d}.npz"
+    arrays = {f"L{i:04d}": l for i, l in enumerate(leaves)}
+    name = f"ckpt-{source_pos:010d}.npz"
     path = os.path.join(directory, name)
     if jax.process_count() > 1 and jax.process_index() != 0:
         # the gather above was collective; only the coordinator writes
@@ -307,39 +354,97 @@ def save_checkpoint(
     return path
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def validate_checkpoint(path: str) -> Optional[str]:
+    """Cheap full-read validation: returns None when ``path`` is a
+    loadable snapshot of this build's format, else a reason string
+    (partial write, corrupt payload, version mismatch, unreadable)."""
+    try:
+        meta, leaves = _read_npz(path)
+    except KeyError:
+        return "no metadata (partial or foreign file)"
+    except Exception as e:
+        return f"unreadable ({type(e).__name__}: {e})"
+    if meta.get("version") != FORMAT_VERSION:
+        return (
+            f"format version {meta.get('version')} != this build's "
+            f"{FORMAT_VERSION}"
+        )
+    saved = meta.get("checksum")
+    if saved is not None and _checksum(leaves) != saved:
+        return "payload checksum mismatch (corrupt)"
+    return None
+
+
+def latest_checkpoint(directory: str, flight=None) -> Optional[str]:
+    """Newest VALID snapshot in ``directory`` (the ``latest`` marker's
+    target first, then the remaining snapshots newest-first). Partial,
+    corrupt, or version-incompatible files are skipped — with a
+    ``checkpoint_skipped`` flight breadcrumb when a recorder is passed —
+    instead of being handed to the supervisor as an unloadable path."""
+    if not os.path.isdir(directory):
+        return None
+    candidates: List[str] = []
     marker = os.path.join(directory, "latest")
     if os.path.exists(marker):
-        with open(marker) as f:
-            name = f.read().strip()
-        p = os.path.join(directory, name)
-        if os.path.exists(p):
-            return p
-    snaps = sorted(
+        try:
+            with open(marker) as f:
+                name = f.read().strip()
+            if name:
+                candidates.append(name)
+        except OSError:
+            pass
+    for n in sorted(
         n for n in os.listdir(directory)
         if n.startswith("ckpt-") and n.endswith(".npz")
-    ) if os.path.isdir(directory) else []
-    return os.path.join(directory, snaps[-1]) if snaps else None
+    )[::-1]:
+        if n not in candidates:
+            candidates.append(n)
+    for name in candidates:
+        p = os.path.join(directory, name)
+        reason = (
+            "missing" if not os.path.exists(p) else validate_checkpoint(p)
+        )
+        if reason is None:
+            return p
+        if flight is not None:
+            flight.record("checkpoint_skipped", path=p, reason=reason)
+    return None
+
+
+def _read_npz(path: str):
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+        names = sorted(k for k in z.files if k.startswith("L"))
+        leaves = [z[k] for k in names]
+    return meta, leaves
 
 
 def load_checkpoint(path: str) -> Checkpoint:
-    """Load an ``.npz`` snapshot (or the latest one in a directory)."""
+    """Load an ``.npz`` snapshot (or the latest valid one in a
+    directory). Raises ValueError on a version mismatch or a payload
+    that fails its recorded checksum."""
     if os.path.isdir(path):
         p = latest_checkpoint(path)
         if p is None:
             raise FileNotFoundError(f"no checkpoint found in {path}")
         path = p
-    with np.load(path) as z:
-        meta = json.loads(bytes(z[_META_KEY]).decode())
-        if meta.get("version") != FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint format version {meta.get('version')} does not "
-                f"match this build's {FORMAT_VERSION} — the snapshot was "
-                "written by a different tpustream version; restart the job "
-                "from the source instead of resuming"
-            )
-        names = sorted(k for k in z.files if k.startswith("L"))
-        leaves = [z[k] for k in names]
+    meta, leaves = _read_npz(path)
+    if meta.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format version {meta.get('version')} does not "
+            f"match this build's {FORMAT_VERSION} — the snapshot was "
+            "written by a different tpustream version; restart the job "
+            "from the source instead of resuming"
+        )
+    saved_crc = meta.get("checksum")
+    if saved_crc is not None and _checksum(leaves) != saved_crc:
+        raise ValueError(
+            f"checkpoint {path} is corrupt: payload checksum "
+            f"{_checksum(leaves):#010x} does not match the recorded "
+            f"{saved_crc:#010x} — the file was truncated or modified "
+            "after writing; pick an older snapshot (latest_checkpoint "
+            "skips corrupt files automatically)"
+        )
     return Checkpoint(
         leaves=leaves,
         record_kinds=meta["record_kinds"],
@@ -353,4 +458,7 @@ def load_checkpoint(path: str) -> Checkpoint:
         lazy_schemas=meta.get("lazy_schemas", []),
         key_capacities=meta.get("key_capacities", []),
         chain_key_tables=meta.get("chain_key_tables", []),
+        sink_counts=meta.get("sink_counts"),
+        quarantined=meta.get("quarantined", 0),
+        session=meta.get("session"),
     )

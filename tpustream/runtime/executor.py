@@ -11,6 +11,7 @@ the reference READMEs replays exactly (SURVEY.md §4).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -29,7 +30,13 @@ from ..records import STR, Batch, Column, DerivedKeyTable, StringTable
 from ..api.timeapi import TimeCharacteristic
 from .metrics import Metrics, Stopwatch
 from .plan import JobPlan, build_plan_chain
-from .sinks import CollectSink, EmissionFormatter, FnSink, PrintSink
+from .sinks import (
+    CollectSink,
+    EmissionFormatter,
+    FnSink,
+    PrintSink,
+    RetryingSink,
+)
 from .sources import SourceBatch
 from .step import LONG_MIN, build_program
 
@@ -352,12 +359,29 @@ class JobResult:
 def _make_sinks(plan: JobPlan, cfg: StreamConfig):
     pp = cfg.print_parallelism if cfg.print_parallelism is not None else cfg.parallelism
 
+    inj = cfg.extra.get("fault_injector") if cfg.extra else None
+    fault = inj.check if inj is not None else None
+
     def build_sink(node):
         if node.op == "sink_print":
-            return PrintSink(parallelism=pp)
-        if node.op == "sink_collect":
-            return CollectSink(node.params["handle"])
-        return FnSink(node.params["fn"])
+            sink = PrintSink(parallelism=pp)
+        elif node.op == "sink_collect":
+            sink = CollectSink(node.params["handle"])
+        else:
+            sink = FnSink(node.params["fn"])
+        # transient-failure backoff (StreamConfig.sink_retries), and the
+        # mount point for injected sink_emit faults — wrapped even at
+        # retries=0 under injection so the fault fires on the real emit
+        # path and escalates like a genuine sink error
+        if cfg.sink_retries > 0 or fault is not None:
+            sink = RetryingSink(
+                sink,
+                attempts=cfg.sink_retries,
+                base_ms=cfg.sink_retry_base_ms,
+                max_ms=cfg.sink_retry_max_ms,
+                fault=fault,
+            )
+        return sink
 
     # (host-side branch ops, sink) per main branch — ops run over the
     # compacted emissions (alert-scale), mirroring the reference's
@@ -381,6 +405,10 @@ class Runner:
         self.plan = plan
         self.cfg = cfg
         self.metrics = metrics
+        # seeded fault hook (tpustream/testing/faults.py): checked per
+        # step for the device_step / exchange points; None in real runs
+        _inj = cfg.extra.get("fault_injector") if cfg.extra else None
+        self._fault = _inj.check if _inj is not None else None
         self.program = build_program(plan, cfg)
         self._inner_step = self.program.jitted_step()
         # per-operator observability scope: counters/histograms labelled
@@ -523,8 +551,14 @@ class Runner:
                 )
             for i, (_, sink) in enumerate(self.sinks):
                 sink.obs_counter = self.obs.counter(f"sink{i}_emitted")
+                if isinstance(sink, RetryingSink):
+                    sink.retry_counter = self.obs.counter(f"sink{i}_retries")
             for tag, (_, sink) in self.side_sinks.items():
                 sink.obs_counter = self.obs.counter(f"side_sink{tag}_emitted")
+                if isinstance(sink, RetryingSink):
+                    sink.retry_counter = self.obs.counter(
+                        f"side_sink{tag}_retries"
+                    )
         # marker latency series: source->this-operator-edge, and (for
         # the terminal stage) source->each-sink. Null instruments when
         # obs is off — and markers never exist then anyway.
@@ -901,6 +935,10 @@ class Runner:
     def _run_step(self, inputs, wm_lower: int, t_batch=None):
         """One jitted step + emission dispatch (the only step call site)."""
         self._ensure_step()
+        if self._fault is not None:
+            self._fault("device_step")
+            if self.program.n_shards > 1:
+                self._fault("exchange")
         packed, bases, valid, ts_p, ts_b = inputs
         if self._multiproc:
             # batch-sized leaves become global arrays (scalars replicate
@@ -1793,17 +1831,38 @@ def _prefetch_iter(it, depth: int, depth_gauge=None):
 
 
 def execute_job(env, sink_nodes) -> JobResult:
-    """Run the job; on ANY failure, write the flight-recorder postmortem
-    (terminal exception + the operator that was active + the event ring)
-    before re-raising. ``env.metrics`` is installed as soon as the
-    Metrics facade exists, so even a crashed job leaves its partial
-    counters readable."""
+    """Run the job, supervised when a restart strategy is configured.
+
+    With ``StreamConfig.restart_strategy`` set, failures route through
+    runtime/supervisor.py: the strategy decides whether the job
+    restarts, and a restart rebuilds the chain and resumes exactly-once
+    from the latest valid checkpoint. Unset (the default), the first
+    failure propagates exactly as before supervision existed."""
+    if getattr(env.config, "restart_strategy", None) is not None:
+        from .supervisor import supervise
+
+        return supervise(env, sink_nodes, _run_attempt)
+    return _run_attempt(env, sink_nodes)
+
+
+def _run_attempt(env, sink_nodes) -> JobResult:
+    """One execution attempt; on ANY failure, write the flight-recorder
+    postmortem (terminal exception + the operator that was active + the
+    event ring) before re-raising. ``env.metrics`` is installed as soon
+    as the Metrics facade exists, so even a crashed job leaves its
+    partial counters readable."""
     try:
         result = _execute_job(env, sink_nodes)
     except BaseException as e:
         job_obs = getattr(getattr(env, "metrics", None), "job_obs", None)
         if job_obs is not None:
-            job_obs.on_failure(e)
+            # a supervised attempt may restart: the postmortem dump is
+            # the SUPERVISOR's call (written only when it gives up), not
+            # every failed attempt's — a recovered job must not litter
+            # cwd with "failed" dumps
+            job_obs.on_failure(
+                e, dump=getattr(env, "_supervision", None) is None
+            )
         raise
     job_obs = getattr(env.metrics, "job_obs", None)
     if job_obs is not None:
@@ -1817,13 +1876,23 @@ def _execute_job(env, sink_nodes) -> JobResult:
     plan = plans[0]
     chained = len(plans) > 1
     host = HostStage(plan, cfg)
+    # supervised execution (runtime/supervisor.py): cross-attempt state —
+    # the shared flight ring, cumulative restart counters to re-seed,
+    # and the session nonce checkpoints are stamped with
+    supervision = getattr(env, "_supervision", None)
     if cfg.obs.enabled:
         from ..obs.flightrecorder import jsonable_config
         from ..obs.runtime import JobObs
 
-        job_obs = JobObs(cfg.obs, job_name=env.job_name or "job")
+        job_obs = JobObs(
+            cfg.obs,
+            job_name=env.job_name or "job",
+            flight=supervision.flight if supervision is not None else None,
+        )
         metrics = Metrics(registry=job_obs.registry, job_name=job_obs.job_name)
         metrics.job_obs = job_obs
+        if supervision is not None:
+            supervision.seed_metrics(job_obs)
         # first flight event: the exact resolved config — every
         # postmortem starts from the knobs the job actually ran with
         job_obs.flight.record(
@@ -1834,6 +1903,28 @@ def _execute_job(env, sink_nodes) -> JobResult:
     else:
         metrics = Metrics()
         job_obs = metrics.job_obs  # the null twin
+    # dead-letter quarantine output (StreamConfig.dead_letter); lives on
+    # the env so it survives restarts and the user reads it after execute
+    dead_letters = getattr(env, "dead_letters", None)
+    if dead_letters is None and cfg.dead_letter:
+        dead_letters = env.dead_letters = []
+    # seeded fault-injection hook (tpustream/testing/faults.py): the
+    # injector object outlives restart attempts, so occurrence counters
+    # keep counting across rebuilds
+    injector = cfg.extra.get("fault_injector") if cfg.extra else None
+    fault = injector.check if injector is not None else None
+    # scratch restart (no checkpoint to restore): recovery ends when the
+    # rebuilt attempt starts; checkpointed restarts observe this in the
+    # restore block below instead, after state is back on device
+    if (
+        supervision is not None
+        and getattr(env, "_recovery_t0", None) is not None
+        and not getattr(env, "_checkpoint_restore_path", None)
+    ):
+        job_obs.histogram("recovery_wall_ms").observe(
+            (time.perf_counter() - env._recovery_t0) * 1000.0
+        )
+        env._recovery_t0 = None
     # installed BEFORE the run so the failure wrapper (and the user, via
     # env) can reach the partial metrics of a crashed job; the facade
     # mutates in place from here on
@@ -1892,6 +1983,56 @@ def _execute_job(env, sink_nodes) -> JobResult:
             r.snapshot_counter_baseline()
         skip_lines = ck.source_pos
         proc_now = ck.proc_now
+        if supervision is not None:
+            # Roll buffered outputs back to the snapshot so the replayed
+            # suffix lands exactly once. Collect handles truncate to the
+            # checkpoint's recorded lengths when it was written by THIS
+            # supervised session (nonce match); an older or manual
+            # checkpoint's counts describe some other process's handles,
+            # so those fall back to the supervisor's pre-job baselines.
+            # Unsupervised restores (a fresh env resuming manually)
+            # never truncate — the user owns the handle contents.
+            handles = [
+                n.params["handle"]
+                for n in sink_nodes
+                if n.op == "sink_collect"
+            ]
+            same_session = (
+                ck.session is not None and ck.session == supervision.nonce
+            )
+            counts = (
+                list(ck.sink_counts)
+                if same_session and ck.sink_counts is not None
+                else list(supervision.base_counts)
+            )
+            for h, keep in zip(handles, counts):
+                del h.items[keep:]
+            if dead_letters is not None:
+                keep_dead = (
+                    ck.quarantined if same_session else supervision.base_dead
+                )
+                del dead_letters[keep_dead:]
+                metrics.records_quarantined = len(dead_letters)
+            # recovery accounting: batches the resumed run replays
+            # (skips) to reach the snapshot, and wall time from failure
+            # detection (incl. the restart delay) to restored state
+            supervision.replay_batches_total += ck.batches
+            job_obs.counter("recovery_replay_batches").set_total(
+                supervision.replay_batches_total
+            )
+            t0 = getattr(env, "_recovery_t0", None)
+            if t0 is not None:
+                job_obs.histogram("recovery_wall_ms").observe(
+                    (time.perf_counter() - t0) * 1000.0
+                )
+                env._recovery_t0 = None
+            job_obs.flight.record(
+                "job_restored",
+                checkpoint=restore_path,
+                batches=ck.batches,
+                emitted=ck.emitted,
+                source_pos=ck.source_pos,
+            )
     lines_consumed = skip_lines
     ckpt_every = cfg.checkpoint_interval_batches
     ckpt_enabled = bool(cfg.checkpoint_dir) and ckpt_every > 0
@@ -1953,24 +2094,82 @@ def _execute_job(env, sink_nodes) -> JobResult:
         # parse spans may record from the parse-ahead thread; the
         # tracer's ring append is GIL-safe for this single extra writer
         with job_obs.tracer.span("parse"), Stopwatch() as hw:
-            if sb.raw is not None:
-                batch, wm_hint = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
-                if batch is None and sb.n_raw:
-                    # native lane unavailable: decode and take the line path
-                    lines = sb.raw.decode("utf-8", "replace").split("\n")
-                    if len(lines) == sb.n_raw + 1 and lines[-1] == "":
-                        lines.pop()  # trailing newline
-                    if len(lines) != sb.n_raw:
-                        raise ValueError(
-                            f"raw source batch declares {sb.n_raw} lines "
-                            f"but contains {len(lines)}"
-                        )
-                    batch, wm_hint = host.process(lines, sb.proc_ts)
-            else:
-                batch, wm_hint = host.process(sb.lines, sb.proc_ts)
+            if fault is not None:
+                fault("parse")
+            try:
+                batch, wm_hint = _parse(sb)
+            except Exception as e:
+                # poison-record quarantine (StreamConfig.dead_letter):
+                # divert the bad lines, keep the stream alive. Injected
+                # faults escalate — they model a crash, not bad data.
+                if dead_letters is None or getattr(e, "fault_injection", False):
+                    raise
+                batch, wm_hint = _quarantine(sb, e)
         return sb, batch, wm_hint, hw
 
+    def _parse(sb):
+        if sb.raw is not None:
+            batch, wm_hint = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
+            if batch is None and sb.n_raw:
+                # native lane unavailable: decode and take the line path
+                batch, wm_hint = host.process(_raw_lines(sb), sb.proc_ts)
+            return batch, wm_hint
+        return host.process(sb.lines, sb.proc_ts)
+
+    def _raw_lines(sb):
+        lines = sb.raw.decode("utf-8", "replace").split("\n")
+        if len(lines) == sb.n_raw + 1 and lines[-1] == "":
+            lines.pop()  # trailing newline
+        if len(lines) != sb.n_raw:
+            raise ValueError(
+                f"raw source batch declares {sb.n_raw} lines "
+                f"but contains {len(lines)}"
+            )
+        return lines
+
+    def _quarantine(sb, err):
+        """Re-parse a failed batch line by line: lines that parse feed
+        the device as one (smaller) batch, lines that don't land in
+        ``env.dead_letters`` as ``(line, error)`` pairs — bounded by
+        ``dead_letter_capacity`` (the counter keeps counting past it)."""
+        lines = _raw_lines(sb) if sb.raw is not None else sb.lines
+        good: List[str] = []
+        good_idx: List[int] = []
+        bad = 0
+        first_err = None
+        for i, line in enumerate(lines):
+            try:
+                host.process([line], sb.proc_ts[i : i + 1])
+            except Exception as line_err:
+                bad += 1
+                first_err = first_err if first_err is not None else line_err
+                if len(dead_letters) < cfg.dead_letter_capacity:
+                    dead_letters.append(
+                        (line, f"{type(line_err).__name__}: {line_err}")
+                    )
+            else:
+                good.append(line)
+                good_idx.append(i)
+        if not bad:
+            # the batch failed as a whole but every line parses alone —
+            # a genuine batch-level error, not poison data: escalate
+            raise err
+        metrics.records_quarantined += bad
+        job_obs.flight.record(
+            "records_quarantined",
+            count=bad,
+            total=int(metrics.records_quarantined),
+            error=f"{type(first_err).__name__}: {str(first_err)[:200]}",
+        )
+        return host.process(
+            good, sb.proc_ts[np.asarray(good_idx, dtype=np.int64)]
+        )
+
     source_batches = plan.source.batches(cfg.batch_size, cfg.max_batch_delay_ms)
+    if injector is not None:
+        # source_read faults fire between batch pulls, before any
+        # marker stamping — exactly where a real read error would
+        source_batches = injector.wrap_source(source_batches)
     if job_obs.enabled and cfg.obs.latency_marker_interval_ms > 0:
         # e2e latency markers: stamped at the source, riding the same
         # pack/dispatch/fetch/emit path as records (obs/latency.py).
@@ -2112,34 +2311,68 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 for r in stages
                 if getattr(r, "_lazy_schema", False)
             ]
-            save_checkpoint(
-                cfg.checkpoint_dir,
-                lazy_schemas=lazy_schemas,
-                key_capacities=[r.cfg.key_capacity for r in stages],
-                # only non-lazy CHAIN stages need this: stage 0's
-                # derived table rides meta["tables"], lazy stages' ride
-                # lazy_schemas
-                chain_key_tables=[
-                    r.plan.tables[-1].state_dict()
-                    if si > 0
-                    and r.plan.synthetic_key
-                    and not getattr(r, "_lazy_schema", False)
-                    and r.plan.tables
-                    else None
-                    for si, r in enumerate(stages)
-                ],
-                state=(
-                    [r.state for r in stages]
-                    if len(stages) > 1
-                    else runner.state
-                ),
-                plan=plan,
-                source_pos=lines_consumed,
-                proc_now=proc_now,
-                emitted=emitted,
+            with Stopwatch() as ck_sw:
+                ck_path = save_checkpoint(
+                    cfg.checkpoint_dir,
+                    lazy_schemas=lazy_schemas,
+                    key_capacities=[r.cfg.key_capacity for r in stages],
+                    # only non-lazy CHAIN stages need this: stage 0's
+                    # derived table rides meta["tables"], lazy stages'
+                    # ride lazy_schemas
+                    chain_key_tables=[
+                        r.plan.tables[-1].state_dict()
+                        if si > 0
+                        and r.plan.synthetic_key
+                        and not getattr(r, "_lazy_schema", False)
+                        and r.plan.tables
+                        else None
+                        for si, r in enumerate(stages)
+                    ],
+                    state=(
+                        [r.state for r in stages]
+                        if len(stages) > 1
+                        else runner.state
+                    ),
+                    plan=plan,
+                    source_pos=lines_consumed,
+                    proc_now=proc_now,
+                    emitted=emitted,
+                    batches=metrics.batches,
+                    job_name=env.job_name,
+                    parallelism=max(1, cfg.parallelism),
+                    # supervised-recovery metadata: collect-sink lengths
+                    # at the snapshot (output rollback on restore),
+                    # quarantine high-water mark, and the supervision
+                    # session nonce that scopes both
+                    sink_counts=[
+                        len(n.params["handle"].items)
+                        for n in sink_nodes
+                        if n.op == "sink_collect"
+                    ],
+                    quarantined=(
+                        len(dead_letters) if dead_letters is not None else 0
+                    ),
+                    session=(
+                        supervision.nonce if supervision is not None else None
+                    ),
+                )
+            # snapshot cost series (docs/observability.md)
+            job_obs.histogram("checkpoint_save_ms").observe(
+                ck_sw.elapsed * 1000.0
+            )
+            if ck_path:
+                try:
+                    job_obs.histogram("checkpoint_bytes").observe(
+                        os.path.getsize(ck_path)
+                    )
+                except OSError:
+                    pass
+            job_obs.flight.record(
+                "checkpoint_saved",
+                path=ck_path,
                 batches=metrics.batches,
-                job_name=env.job_name,
-                parallelism=max(1, cfg.parallelism),
+                source_pos=lines_consumed,
+                save_ms=round(ck_sw.elapsed * 1000.0, 3),
             )
         t_iter_done = time.perf_counter()
         if sb.final:

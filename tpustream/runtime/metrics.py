@@ -72,6 +72,9 @@ class Metrics:
         "records_emitted",
         "window_fires",
         "late_dropped",
+        # data-plane poison lines diverted to env.dead_letters instead of
+        # failing the job (StreamConfig.dead_letter)
+        "records_quarantined",
         # device-side overflow/loss counters (see StreamConfig.strict_overflow)
         "alert_overflow",
         "exchange_overflow",
@@ -112,6 +115,7 @@ class Metrics:
             "records_emitted": self.records_emitted,
             "window_fires": self.window_fires,
             "late_dropped": self.late_dropped,
+            "records_quarantined": self.records_quarantined,
             "alert_overflow": self.alert_overflow,
             "exchange_overflow": self.exchange_overflow,
             "buffer_overflow": self.buffer_overflow,

@@ -82,6 +82,16 @@ class Source:
     # by yielding a ``final`` batch (socket close, iterator exhaustion, or
     # replay end), and the executor then emits the Flink end-of-source
     # MAX watermark / final processing-time tick uniformly.
+
+    # Whether a fresh ``batches()`` call re-yields the SAME stream from
+    # the start — the property supervised restart (runtime/supervisor.py)
+    # needs to resume exactly-once from a checkpoint's source position.
+    # The deterministic replay sources are; a consumed iterator or a live
+    # socket is not (the supervisor then refuses to restart, with a
+    # flight breadcrumb, instead of silently resuming a different
+    # stream).
+    replayable = True
+
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         raise NotImplementedError  # pragma: no cover
 
@@ -161,6 +171,8 @@ class ReplayBytesSource(Source):
 class IterableSource(Source):
     """Wraps any (possibly infinite) iterator of lines; wall-clock stamped."""
 
+    replayable = False  # the iterator is consumed as it streams
+
     def __init__(self, it: Iterable):
         self._it = iter(it)
 
@@ -189,6 +201,8 @@ class SocketTextSource(Source):
     executor's native raw ingest lane. Per-line arrival stamps coarsen
     to the block's receive time (the same instant up to one ``recv``).
     """
+
+    replayable = False  # live network stream: gone once read
 
     def __init__(
         self,

@@ -1,0 +1,156 @@
+"""Seeded fault injection for supervised-execution tests and bench.
+
+The runtime exposes five control-plane fault points, checked on the
+paths named after them:
+
+* ``source_read``  — before each source batch enters the host stage
+* ``parse``        — before the host parse of a batch (distinct from a
+  data-plane parse error: an injected parse fault escalates to the
+  supervisor, a malformed LINE is quarantined — see
+  StreamConfig.dead_letter)
+* ``device_step``  — before each jitted step dispatch
+* ``exchange``     — before a sharded (n_shards > 1) step's keyBy
+  all_to_all
+* ``sink_emit``    — inside each sink emit attempt (so sink retry
+  with backoff is exercised; see runtime/sinks.py RetryingSink)
+
+An injector installs into ``StreamConfig.extra["fault_injector"]`` (use
+:meth:`FaultInjector.install`); the executor reads it from there so the
+runtime never imports this module. The injector OUTLIVES supervised
+restart attempts — occurrence counters keep counting across rebuilds,
+so a fault scheduled ``at`` occurrence k fires exactly once and the
+replayed occurrences after the restart do not re-trigger it.
+
+Determinism: ``at`` faults are positional; probabilistic faults draw
+from one ``random.Random(seed)`` in occurrence order, so the same
+schedule over the same stream yields the same fault sequence.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+FAULT_POINTS = (
+    "source_read",
+    "parse",
+    "device_step",
+    "exchange",
+    "sink_emit",
+)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by FaultInjector.check at a scheduled fault point.
+
+    ``fault_injection`` marks the exception so data-plane error handling
+    (dead-letter quarantine, which catches parse exceptions) lets it
+    escalate to the supervisor instead of swallowing it as a bad record.
+    """
+
+    fault_injection = True
+
+    def __init__(self, point: str, occurrence: int):
+        super().__init__(
+            f"injected fault at {point} (occurrence {occurrence})"
+        )
+        self.point = point
+        self.occurrence = occurrence
+
+
+@dataclass
+class FaultPoint:
+    """One scheduled fault.
+
+    ``at``: fire at this 0-based occurrence of ``point`` (positional,
+    fully deterministic). ``p``: per-occurrence fire probability when
+    ``at`` is None (seeded). ``times``: total fires before the point
+    goes dormant (1 = fail once, then the restarted attempt sails
+    through — the standard recovery-test shape).
+    """
+
+    point: str
+    at: Optional[int] = None
+    p: float = 0.0
+    times: int = 1
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r}; one of {FAULT_POINTS}"
+            )
+
+
+class FaultInjector:
+    """Evaluates a schedule of :class:`FaultPoint` s. One instance per
+    job; thread-compatible with the parse-ahead thread (the executor
+    sequences per-point checks from a single thread each)."""
+
+    def __init__(self, *points: FaultPoint, seed: int = 0):
+        self.points = list(points)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._occurrences = {}      # point name -> occurrences seen
+        self._fires = [0] * len(self.points)
+        self.log: List[Tuple[str, int]] = []  # (point, occurrence) fired
+
+    @property
+    def fired(self) -> int:
+        return len(self.log)
+
+    def occurrences(self, point: str) -> int:
+        return self._occurrences.get(point, 0)
+
+    def check(self, point: str) -> None:
+        """Count one occurrence of ``point``; raise FaultInjected if a
+        scheduled fault is due."""
+        occ = self._occurrences.get(point, 0)
+        self._occurrences[point] = occ + 1
+        for i, fp in enumerate(self.points):
+            if fp.point != point or self._fires[i] >= fp.times:
+                continue
+            if fp.at is not None:
+                hit = occ == fp.at or (
+                    fp.times > 1 and fp.at <= occ < fp.at + fp.times
+                )
+            else:
+                # one draw per live probabilistic point per occurrence,
+                # in schedule order — deterministic under a fixed seed
+                hit = fp.p > 0.0 and self._rng.random() < fp.p
+            if hit:
+                self._fires[i] += 1
+                self.log.append((point, occ))
+                raise FaultInjected(point, occ)
+
+    def wrap_source(self, batches):
+        """Wrap a source-batch iterator: one ``source_read`` occurrence
+        per batch, checked before the batch is handed to the host
+        stage."""
+        for sb in batches:
+            self.check("source_read")
+            yield sb
+
+    def install(self, cfg):
+        """Return ``cfg`` with this injector installed in
+        ``extra["fault_injector"]`` (where the executor looks)."""
+        extra = dict(cfg.extra)
+        extra["fault_injector"] = self
+        return cfg.replace(extra=extra)
+
+
+def poison_lines(
+    lines: List[str],
+    count: int = 1,
+    seed: int = 0,
+    poison: str = "!!poison not-a-record!!",
+) -> Tuple[List[str], int]:
+    """Insert ``count`` malformed lines at seeded positions. The default
+    payload fails every chapter parser (too few fields for the index
+    access, non-numeric where a number is parsed). Returns
+    ``(new_lines, count)``."""
+    out = list(lines)
+    rng = random.Random(seed)
+    for _ in range(count):
+        out.insert(rng.randrange(len(out) + 1), poison)
+    return out, count
