@@ -903,6 +903,94 @@ def device_chain(stream_hash):
     return TIMED * 50 * B_1 / dt, int(np.asarray(tot)) - tot0
 
 
+def device_cep(stream_hash, B_p=1 << 17, key_counts=(1 << 14, 1 << 17),
+               lengths=(2, 3, 5), warm=2, timed=3, chunk_len=50):
+    """Phase P: CEP pattern throughput — the vectorized on-device NFA
+    (runtime/cep_program.py) swept over keys x pattern length. Stream:
+    uniform keys, ~1/4 of events breach the threshold, so with
+    ``times(L).consecutive()`` partials form and die continuously
+    (~4^-L of events complete a match); ``within(1 s)`` keeps the
+    watermark timeout sweep active every step. Per-event device work is
+    the [B, L] advance + one register-plane scatter, so the sweep shows
+    how rate moves with L (register planes) and K (state height)."""
+    import jax.numpy as jnp
+
+    from tpustream import (
+        BoundedOutOfOrdernessTimestampExtractor,
+        CEP,
+        Pattern,
+        Time,
+        TimeCharacteristic,
+        Tuple2,
+    )
+    from tpustream.config import StreamConfig
+    from tpustream.javacompat import Long
+
+    rec_per_ms = SIM_RATE // 1000
+    WITHIN_MS = 1_000
+
+    class Ts(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.seconds(1))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    def one(K_p, L_p):
+        def job(env, text):
+            keyed = (
+                text.assign_timestamps_and_watermarks(Ts())
+                .map(
+                    lambda l: Tuple2(
+                        l.split(" ")[1], Long.parseLong(l.split(" ")[2])
+                    )
+                )
+                .key_by(0)
+            )
+            pat = (
+                Pattern.begin("b").where(lambda r: r.f1 > 500)
+                .times(L_p).consecutive()
+                .within(Time.milliseconds(WITHIN_MS))
+            )
+            return CEP.pattern(keyed, pat).select(
+                lambda m: Tuple2(m["b"][0].f0, m["b"][-1].f1)
+            )
+
+        cfg = StreamConfig(
+            batch_size=B_p, key_capacity=K_p, alert_capacity=1 << 16,
+        )
+        program = _program_for(job, cfg, TimeCharacteristic.EventTime)
+
+        def gen(i):
+            g, h = stream_hash(i, B_p)
+            ts = BASE_MS + g // rec_per_ms
+            keys = (h % K_p).astype(jnp.int32)
+            vals = jnp.where((h >> 7) % 4 == 0, 1000, 10).astype(jnp.int64)
+            return (keys, vals), jnp.ones(B_p, bool), ts
+
+        LONG_MIN_ = -(2 ** 62)
+        return _scan_bench(
+            program, gen, lambda i: jnp.asarray(LONG_MIN_, jnp.int64),
+            B_p, warm_chunks=warm, timed_chunks=timed, chunk_len=chunk_len,
+        )
+
+    sweep = []
+    for K_p in key_counts:
+        for L_p in lengths:
+            rate, matches = one(K_p, L_p)
+            sweep.append(
+                dict(
+                    keys=K_p, pattern_len=L_p,
+                    events_per_s=round(rate), matches=matches,
+                )
+            )
+            log(
+                f"phase P: CEP L={L_p}, {K_p} keys: {rate/1e6:.1f}M "
+                f"events/s/chip, {matches} matches"
+            )
+    return dict(batch=B_p, within_ms=WITHIN_MS, sweep=sweep)
+
+
 def decompose_full_path(n_batches=10):
     """Stage-attributed account of the full execute_job path (VERDICT r3
     next #4): run the flagship shape batch by batch SYNCHRONOUSLY and
@@ -1515,6 +1603,13 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase M skipped: {e}")
 
+    # ---- Phase P: CEP pattern throughput (keys x pattern length) --------
+    cep_sweep = None
+    try:
+        cep_sweep = device_cep(stream_hash)
+    except Exception as e:  # pragma: no cover
+        log(f"phase P skipped: {e}")
+
     # ---- Phase C: native parse throughput -------------------------------
     parse_rate = None
     try:
@@ -1682,6 +1777,9 @@ def main():
                     "chain_two_stage_events_per_s": round(
                         chain_dev_rate or 0
                     ),
+                    # phase P: the CEP NFA device pipeline swept over
+                    # keys x pattern length (docs/cep.md)
+                    "cep": cep_sweep,
                     # environment context for the full-path numbers: the
                     # chip sits behind a tunnel; H2D is the binding stage
                     "h2d_bandwidth_mb_per_s": round(h2d_mb_s or 0),

@@ -1,0 +1,379 @@
+"""CEP pattern matching (tpustream/cep/ + runtime/cep_program.py):
+device output vs the pure-Python oracle NFA across the edge cases the
+vectorized advance must get right — strict/relaxed contiguity,
+overlapping ``times()`` partials, ``within()`` timeouts exactly at the
+watermark boundary, late events under allowed lateness — plus the
+single-chip vs p=8 mesh parity of the chapter-4 job."""
+
+import numpy as np
+import pytest
+
+from tpustream import (
+    CEP,
+    BoundedOutOfOrdernessTimestampExtractor,
+    OutputTag,
+    Pattern,
+    PatternSelectFunction,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple2,
+    Tuple3,
+)
+from tpustream.cep import compile_pattern, run_oracle
+from tpustream.config import StreamConfig
+from tpustream.javacompat import Long
+from tpustream.runtime.sources import ReplaySource
+
+# ---------------------------------------------------------------------------
+# line format: "<epoch-sec> <channel> <value>" (chapter-2 style)
+# ---------------------------------------------------------------------------
+
+
+class SecondExtractor(BoundedOutOfOrdernessTimestampExtractor):
+    def __init__(self, delay=None):
+        super().__init__(delay or Time.seconds(0))
+
+    def extract_timestamp(self, element):
+        return Long.parseLong(element.split(" ")[0]) * 1000
+
+
+def parse(s):
+    items = s.split(" ")
+    return Tuple3(
+        Long.parseLong(items[0]), items[1], Long.parseLong(items[2])
+    )
+
+
+def lines_of(events):
+    """events: (sec, channel, value) triples."""
+    return [f"{t} {ch} {v}" for t, ch, v in events]
+
+
+def run_cep(
+    events, pattern, select_fn=None, batch_size=2, parallelism=1,
+    delay=None, allowed_lateness=None, late_tag=None, timeout_tag=None,
+    **cfg_over,
+):
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=batch_size, parallelism=parallelism,
+                     **cfg_over)
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines_of(events)))
+    keyed = (
+        text.assign_timestamps_and_watermarks(SecondExtractor(delay))
+        .map(parse)
+        .key_by(1)
+    )
+    ps = CEP.pattern(keyed, pattern)
+    if allowed_lateness is not None:
+        ps = ps.allowed_lateness(allowed_lateness)
+    if late_tag is not None:
+        ps = ps.side_output_late_data(late_tag)
+    result = ps.select(select_fn, timeout_tag=timeout_tag)
+    h = result.collect()
+    ht = result.get_side_output(timeout_tag).collect() if timeout_tag else None
+    hl = result.get_side_output(late_tag).collect() if late_tag else None
+    env.execute("cep-test")
+    return (
+        h.items,
+        ht.items if ht else [],
+        hl.items if hl else [],
+        env.metrics.summary(),
+    )
+
+
+def oracle_for(events, pattern, batch_size=2, delay_ms=0,
+               allowed_lateness_ms=0):
+    recs = [((t, ch, v), t * 1000) for t, ch, v in events]
+    batches = [
+        recs[i:i + batch_size] for i in range(0, len(recs), batch_size)
+    ]
+    return run_oracle(
+        pattern, batches, delay_ms=delay_ms,
+        allowed_lateness_ms=allowed_lateness_ms,
+    )
+
+
+def flat_matches(oracle_matches):
+    """Oracle match (list of event tuples) -> the device's flat record."""
+    return [tuple(v for ev in m for v in ev) for m in oracle_matches]
+
+
+def timeout_rows(oracle_timeouts, R):
+    """Oracle (n, start, events) -> the device timeout record with its
+    deterministic padding (None for strings, 0 for numbers)."""
+    rows = []
+    for n, start, evs in oracle_timeouts:
+        row = [n, start]
+        for e in range(R):
+            row.extend(evs[e] if e < len(evs) else (0, None, 0))
+        rows.append(tuple(row))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# builder / compiler validation
+# ---------------------------------------------------------------------------
+def test_pattern_builder_validation():
+    with pytest.raises(ValueError, match="must be >= 1"):
+        Pattern.begin("a").times(0)
+    with pytest.raises(ValueError, match="positive"):
+        Pattern.begin("a").within(0)
+    with pytest.raises(ValueError, match="empty pattern"):
+        compile_pattern(Pattern())
+    with pytest.raises(ValueError, match="duplicate stage names"):
+        compile_pattern(Pattern.begin("a").followed_by("a"))
+    with pytest.raises(ValueError, match="plain filter"):
+        compile_pattern(Pattern.begin("only"))
+    with pytest.raises(ValueError, match="where"):
+        Pattern().where(lambda r: True)
+
+
+def test_compile_expands_times_and_strictness():
+    p = (
+        Pattern.begin("a").next("b").times(3).consecutive()
+        .followed_by("c").within(Time.seconds(5))
+    )
+    cp = compile_pattern(p)
+    assert cp.length == 5
+    assert list(cp.stage_of) == [0, 1, 1, 1, 2]
+    # begin relaxed; b strict entry + consecutive reps; c relaxed
+    assert list(cp.strict) == [False, True, True, True, False]
+    assert cp.within_ms == 5000
+    t = cp.transition_table()
+    assert t.shape == (6, 2)
+    assert list(t[:, 1]) == [1, 2, 3, 4, 5, 5]    # fired: advance
+    # missed: start and relaxed-edge states survive, strict-edge die
+    assert list(t[:, 0]) == [0, -1, -1, -1, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# device vs oracle
+# ---------------------------------------------------------------------------
+REL2 = lambda: (  # noqa: E731 — rebuilt per test (builder mutates)
+    Pattern.begin("a").where(lambda r: r.f2 > 10)
+    .followed_by("b").where(lambda r: r.f2 > 10)
+)
+
+
+def test_relaxed_skips_nonmatching_events():
+    events = [(0, "k", 20), (1, "k", 5), (2, "k", 30), (3, "k", 40)]
+    out, _, _, summ = run_cep(events, REL2())
+    m, _, _ = oracle_for(events, REL2())
+    assert out == flat_matches(m)
+    assert [r[5] for r in out] == [30, 40]  # overlapping {20,30}, {30,40}
+    assert summ["cep_matches"] == 2 and summ["cep_timeouts"] == 0
+
+
+def test_strict_next_kills_broken_runs():
+    strict = lambda: (  # noqa: E731
+        Pattern.begin("a").where(lambda r: r.f2 > 10)
+        .next("b").where(lambda r: r.f2 > 10)
+    )
+    events = [(0, "k", 20), (1, "k", 5), (2, "k", 30), (3, "k", 40)]
+    out, _, _, _ = run_cep(events, strict())
+    m, _, _ = oracle_for(events, strict())
+    assert out == flat_matches(m)
+    # the 5 breaks the 20- run; only the contiguous {30,40} matches
+    assert len(out) == 1 and out[0][2] == 30 and out[0][5] == 40
+
+
+def test_times_overlapping_partials_match_oracle():
+    p = lambda: Pattern.begin("a").where(lambda r: r.f2 > 10).times(3)  # noqa: E731
+    events = [
+        (0, "k", 20), (1, "k", 21), (2, "k", 5), (3, "k", 22),
+        (4, "k", 23), (5, "k", 24),
+    ]
+    out, _, _, _ = run_cep(events, p())
+    m, _, _ = oracle_for(events, p())
+    assert out == flat_matches(m)
+    # relaxed times: {20,21,22}, {21,22,23}, {22,23,24}
+    assert [(r[2], r[5], r[8]) for r in out] == [
+        (20, 21, 22), (21, 22, 23), (22, 23, 24)
+    ]
+
+
+def test_within_timeout_exactly_at_watermark_boundary():
+    p = lambda: (  # noqa: E731
+        Pattern.begin("a").where(lambda r: r.f2 > 10)
+        .followed_by("b").where(lambda r: r.f2 > 10)
+        .within(Time.seconds(10))
+    )
+    tag = OutputTag("to")
+    # partial starts at t=0; the t=10 event is EXACTLY at the within
+    # bound: ts - start == within must NOT extend (strictly-less
+    # semantics), and the watermark reaching start + within exactly
+    # (wm >= start + within) fires the timeout in the same step. The
+    # t=10 event also cannot START a partial: the sweep runs after the
+    # batch's events, so the expired partial still holds the register
+    events = [(0, "k", 20), (10, "k", 30)]
+    out, tmo, _, summ = run_cep(events, p(), batch_size=1, timeout_tag=tag)
+    m, t, _ = oracle_for(events, p(), batch_size=1)
+    assert out == flat_matches(m) == []
+    assert tmo == timeout_rows(t, R=1)
+    assert [(r[0], r[1]) for r in tmo] == [(1, 0)]
+    assert summ["cep_timeouts"] == 1
+    # one second inside the bound: the same shape completes instead
+    events_in = [(0, "k", 20), (9, "k", 30)]
+    out2, tmo2, _, _ = run_cep(events_in, p(), batch_size=1, timeout_tag=tag)
+    m2, t2, _ = oracle_for(events_in, p(), batch_size=1)
+    assert out2 == flat_matches(m2)
+    assert len(out2) == 1 and tmo2 == timeout_rows(t2, R=1)
+
+
+def test_late_events_under_allowed_lateness():
+    p = lambda: (  # noqa: E731
+        Pattern.begin("a").where(lambda r: r.f2 > 10)
+        .followed_by("b").where(lambda r: r.f2 > 10)
+    )
+    late_tag = OutputTag("late")
+    # watermark rides to 100s on key k2; then a k1 event 3s behind the
+    # watermark (inside allowed lateness 5s — still matches) and one
+    # 50s behind (diverted to the late side output)
+    events = [
+        (100, "k1", 20), (100, "k2", 1),
+        (97, "k1", 30),      # behind wm, within lateness: completes
+        (50, "k1", 99),      # beyond lateness: late stream
+    ]
+    al = Time.seconds(5)
+    out, _, late, summ = run_cep(
+        events, p(), batch_size=2, allowed_lateness=al, late_tag=late_tag
+    )
+    m, _, l = oracle_for(events, p(), batch_size=2, allowed_lateness_ms=5000)
+    assert out == flat_matches(m)
+    assert len(out) == 1 and out[0][5] == 30
+    assert [tuple(r) for r in late] == l == [(50, "k1", 99)]
+    assert summ["late_dropped"] == 0  # routed, not dropped
+
+
+def test_select_function_dict_and_java_aliases():
+    class SumSelect(PatternSelectFunction):
+        def select(self, match):
+            a0, a1 = match["spike"]
+            end = match["probe"][0]
+            return Tuple2(a0.f1, a0.f2 + a1.f2 + end.f2)
+
+    # camelCase surface: followedBy + a SAM select class
+    p = (
+        Pattern.begin("spike").where(lambda r: r.f2 > 10).times(2)
+        .followedBy("probe").where(lambda r: r.f2 < 0)
+    )
+    events = [(0, "k", 20), (1, "k", 22), (2, "k", -1)]
+    out, _, _, _ = run_cep(events, p, select_fn=SumSelect())
+    assert [repr(t) for t in out] == ["(k,41)"]
+
+
+def test_multiple_keys_independent_state():
+    p = lambda: (  # noqa: E731
+        Pattern.begin("a").where(lambda r: r.f2 > 10)
+        .next("b").where(lambda r: r.f2 > 10)
+    )
+    # interleaved keys: strict contiguity is PER KEY (k1's run is not
+    # broken by k2's records in between)
+    events = [
+        (0, "k1", 20), (1, "k2", 5), (2, "k1", 30), (3, "k2", 40),
+        (4, "k2", 50),
+    ]
+    out, _, _, _ = run_cep(events, p(), batch_size=2)
+    m, _, _ = oracle_for(events, p(), batch_size=2)
+    assert sorted(out) == sorted(flat_matches(m))
+    assert len(out) == 2  # k1: {20,30}; k2: {40,50}
+
+
+def test_single_batch_multi_event_per_key_rounds():
+    # every event in ONE batch: the while_loop's per-rank rounds must
+    # replay the arrival order within the batch
+    p = lambda: Pattern.begin("a").where(lambda r: r.f2 > 10).times(3)  # noqa: E731
+    events = [(i, "k", 20 + i) for i in range(6)]
+    out, _, _, _ = run_cep(events, p(), batch_size=8)
+    m, _, _ = oracle_for(events, p(), batch_size=8)
+    assert out == flat_matches(m)
+    assert len(out) == 4
+
+
+def test_chapter4_job_matches_oracle_and_p8_parity():
+    from tpustream.jobs.chapter4_cep_alert import build, make_pattern
+    from tpustream.utils.timeutil import iso_local_to_epoch_sec
+
+    LINES = [
+        "2019-08-28T10:00:00 www.163.com 6000",
+        "2019-08-28T10:00:10 www.163.com 7000",
+        "2019-08-28T10:00:20 www.163.com 8000",
+        "2019-08-28T10:00:30 www.sina.com 6100",
+        "2019-08-28T10:00:40 www.sina.com 7100",
+        "2019-08-28T10:01:00 www.163.com 9000",
+        "2019-08-28T10:00:50 www.sina.com 8100",  # out of order, in bound
+        "2019-08-28T10:05:00 www.qq.com 50",      # advances the watermark
+    ]
+
+    def run(p):
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=8, parallelism=p)
+        )
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        text = env.add_source(ReplaySource(LINES))
+        tag = OutputTag("breach-timeout")
+        alerts = build(env, text, timeout_tag=tag)
+        h = alerts.collect()
+        ht = alerts.get_side_output(tag).collect()
+        env.execute(f"cep-chapter4-p{p}")
+        return [repr(t) for t in h.items], [repr(t) for t in ht.items]
+
+    # oracle over the same batch boundaries (batch_size=8: one batch)
+    recs = []
+    for line in LINES:
+        iso, ch, flow = line.split(" ")
+        sec = iso_local_to_epoch_sec(iso)
+        recs.append(((sec, ch, int(flow)), sec * 1000))
+    m, t, _ = run_oracle(make_pattern(), [recs], delay_ms=5000)
+    want_alerts = [
+        f"({b0[1]},{b0[2] + b1[2] + b2[2]},{b0[0]},{b2[0]})"
+        for b0, b1, b2 in m
+    ]
+
+    a1, t1 = run(1)
+    assert a1 == want_alerts
+    assert sorted(t1) == sorted(
+        repr(r) for r in timeout_rows(t, R=2)
+    )
+    a8, t8 = run(8)
+    assert sorted(a8) == sorted(a1)
+    assert sorted(t8) == sorted(t1)
+
+
+def test_processing_time_pattern_no_assigner_needed():
+    # processing time: no timestamp assigner, watermark = max_proc - 1
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=2))
+    env.set_stream_time_characteristic(TimeCharacteristic.ProcessingTime)
+    text = env.add_source(
+        ReplaySource(lines_of([(0, "k", 20), (1, "k", 30)]))
+    )
+    keyed = text.map(parse).key_by(1)
+    p = (
+        Pattern.begin("a").where(lambda r: r.f2 > 10)
+        .followed_by("b").where(lambda r: r.f2 > 10)
+    )
+    h = CEP.pattern(keyed, p).select(
+        lambda match: Tuple2(match["a"][0].f1, match["b"][0].f2)
+    ).collect()
+    env.execute("cep-proctime")
+    assert [repr(t) for t in h.items] == ["(k,30)"]
+
+
+def test_event_time_pattern_requires_assigner():
+    env = StreamExecutionEnvironment(StreamConfig())
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    text = env.add_source(ReplaySource(lines_of([(0, "k", 20)])))
+    keyed = text.map(parse).key_by(1)
+    CEP.pattern(keyed, REL2()).select().collect()
+    with pytest.raises(RuntimeError, match="event-time"):
+        env.execute("cep-no-assigner")
+
+
+def test_cep_requires_keyed_stream():
+    env = StreamExecutionEnvironment(StreamConfig())
+    text = env.add_source(ReplaySource(["x"]))
+    with pytest.raises(TypeError, match="keyed stream"):
+        CEP.pattern(text, REL2())

@@ -44,6 +44,7 @@ from .api.functions import (  # noqa: E402
     ReduceFunction,
 )
 from .api.output import OutputTag  # noqa: E402
+from .cep import CEP, Pattern, PatternSelectFunction  # noqa: E402
 from .config import StreamConfig  # noqa: E402
 from .runtime.supervisor import RestartStrategies  # noqa: E402
 
@@ -53,10 +54,13 @@ __all__ = [
     "AggregateFunction",
     "AssignerWithPeriodicWatermarks",
     "BoundedOutOfOrdernessTimestampExtractor",
+    "CEP",
     "FilterFunction",
     "KeySelector",
     "MapFunction",
     "OutputTag",
+    "Pattern",
+    "PatternSelectFunction",
     "ProcessWindowFunction",
     "ReduceFunction",
     "RestartStrategies",

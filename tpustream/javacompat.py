@@ -14,6 +14,10 @@ from __future__ import annotations
 
 import datetime as _dt
 
+from .cep import CEP, Pattern, PatternSelectFunction  # noqa: F401 — the
+# FlinkCEP surface re-exported with its Java camelCase methods
+# (Pattern.begin(..).followedBy(..).within(..), PatternStream
+# .sideOutputLateData) so chapter-style jobs read like the original
 from .hostparse import PExpr, SymNum, SymStr
 from .utils.timeutil import iso_local_to_epoch_sec
 

@@ -179,6 +179,10 @@ def _selftest() -> int:
         cs.observe(v)
     g.histogram("checkpoint_bytes").observe(8192.0)
     g.group(cause="device_step").counter("job_restarts_total").inc(2)
+    # CEP series (docs/cep.md): per-job match/timeout counters the
+    # pattern operator mints through the same registry path
+    g.counter("cep_matches").inc(7)
+    g.counter("cep_timeouts").inc(3)
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -256,6 +260,11 @@ def _selftest() -> int:
          "checkpoint_save_ms" in text and "checkpoint_bytes" in text),
         ("prometheus carries the restart cause label",
          "job_restarts_total" in prom and 'cause="device_step"' in prom),
+        ("render names the cep counters",
+         "cep_matches" in text and "cep_timeouts" in text),
+        ("prometheus carries the cep counters",
+         'cep_matches{job="selftest"} 7' in prom
+         and 'cep_timeouts{job="selftest"} 3' in prom),
         ("render includes health", "health: CRIT" in text),
         ("prometheus escapes the hostile label",
          'operator="he\\"llo\\\\wo\\nrld"' in prom),

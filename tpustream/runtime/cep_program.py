@@ -1,0 +1,412 @@
+"""CEP pattern matching as one jitted XLA program.
+
+Executes a compiled linear NFA (tpustream/cep/nfa.py) over keyed HBM
+state: per key, one register per non-start NFA state — occupancy bit
+``occ[K, R]``, window-start timestamp ``start_ts[K, R]``, and captured
+event columns ``cap<i>[K, R, R]`` (register r uses capture slots
+0..r) — where R = L - 1 for an L-step pattern.
+
+Per step (mirroring window_program's event-time skeleton):
+
+  1. masked pre-chain, watermark update (monotone ``max_seen - delay``),
+  2. keyBy exchange (ICI all_to_all when sharded), late split against
+     the pre-batch watermark (late events divert to the "late" stream),
+  3. every stage condition evaluates vectorized over the whole batch
+     into a ``[B, n_stages]`` bool matrix; per-step transition bits are
+     a one-hot gather through the compiled table's ``stage_of`` axis,
+  4. records sort stably by key; one ``while_loop`` round per
+     within-batch arrival rank advances AT MOST ONE event per key —
+     but ALL keys at once, each round a handful of [B, R]-shaped
+     gathers/wheres and one unique-index scatter per state leaf.
+     The advance resolves register collisions top-down (an accepted
+     advance consumes its source; an occupied target that neither
+     advanced out nor died keeps its OLDER partial), strict edges
+     (`next`/`consecutive`) kill partials their event failed to extend,
+     and ``within`` gates every edge by ``ts - start < within_ms``,
+  5. completed matches (flat L*C event-major columns) run the device
+     post chain and compact into the alert buffer in arrival order;
+     expired partials (watermark >= start + within) emit to the
+     "timeout" stream and clear.
+
+State rides the default checkpoint machinery: every array leaf has the
+canonical leading key axis, so BaseProgram's shard-major
+rescale/grow-key layouts apply unchanged and supervised restarts
+recover match state exactly-once.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.functions import as_callable
+from ..api.timeapi import TimeCharacteristic
+from ..records import I64, NUMPY_DTYPES, STR
+from ..ops import panes as pane_ops
+from ..ops.panes import W0
+from ..ops.segments import inverse_permutation, segment_ranks, sort_by_key
+from .device import DeviceChain, wrap_record
+from .plan import JobPlan
+from .step import BaseProgram
+
+
+class CepProgram(BaseProgram):
+    operator_name = "cep"
+    main_emission_prefix = True  # matches compact into a prefix buffer
+    OBS_STATE_SCALARS = ("wm", "max_ts", "cep_partials")
+
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        st = plan.stateful
+        cp = st.cep
+        self.compiled = cp
+        self.key_pos = plan.key_pos
+        self.L = cp.length
+        self.R = cp.length - 1
+        self.within_ms = cp.within_ms
+        # only within() gives clock ticks (EOS flush) anything to emit
+        self.fires_on_clock = bool(cp.within_ms)
+        self.allowed_lateness_ms = st.allowed_lateness_ms
+        self.timeout_tag = st.timeout_tag
+        if (
+            plan.time_characteristic == TimeCharacteristic.EventTime
+            and plan.ts_assigner is None
+            and not plan.upstream_supplies_ts
+        ):
+            raise RuntimeError(
+                "CEP patterns are event-time operators: add "
+                "assign_timestamps_and_watermarks before the pattern "
+                "(or run the job in processing time)"
+            )
+        if plan.time_characteristic == TimeCharacteristic.EventTime:
+            self.delay_ms = plan.ts_delay_ms
+        else:
+            # processing time: wm = max_proc_seen - 1 (timer semantics)
+            self.delay_ms = 1
+        # Flink counts late records as dropped only when no side output
+        # consumes them
+        late_routed = st.late_tag is not None and any(
+            so.tag == st.late_tag for so in plan.side_outputs
+        )
+        self.count_late_as_dropped = not late_routed
+        self.n_shards = 1
+        self.local_key_capacity = cfg.key_capacity
+
+        C = len(self.mid_kinds)
+        # match record: the L matched events' fields, event-major
+        # (ev0.f0, ev0.f1, .., ev1.f0, ..)
+        match_kinds = [k for _ in range(self.L) for k in self.mid_kinds]
+        match_tables = [t for _ in range(self.L) for t in self.mid_tables]
+        self.post_chain = DeviceChain(plan.device_post, match_kinds, match_tables)
+        self.out_kinds = self.post_chain.out_kinds
+        self.out_tables = self.post_chain.out_tables
+        # timeout record: (n_matched, start_ts, then R capture slots'
+        # fields; slots >= n_matched padded with 0 / None)
+        self.timeout_kinds = [I64, I64] + [
+            k for _ in range(self.R) for k in self.mid_kinds
+        ]
+        self.timeout_tables = [None, None] + [
+            t for _ in range(self.R) for t in self.mid_tables
+        ]
+        self.STATE_COMPONENT_KEYS = {
+            "nfa_registers": ("occ", "start_ts"),
+            "nfa_captures": tuple(f"cap{i}" for i in range(C)),
+        }
+        self._conds = self._build_conds()
+
+    # ------------------------------------------------------------------
+    def _build_conds(self):
+        """One batch-vectorized predicate per STAGE (ANDed where()
+        conditions over the visible record, traced like filter fns)."""
+        kinds, tables = self.mid_kinds, self.mid_tables
+        outs = []
+        for stage_conds in self.compiled.conds:
+            fns = tuple(as_callable(c, "filter") for c in stage_conds)
+
+            def stage_fn(cols, _fns=fns):
+                def one(scalars):
+                    rec = wrap_record(kinds, tables, list(scalars))
+                    ok = jnp.asarray(True)
+                    for f in _fns:
+                        ok = jnp.logical_and(ok, jnp.asarray(f(rec)))
+                    return ok
+
+                return jax.vmap(one)(tuple(cols))
+
+            outs.append(stage_fn)
+        return outs
+
+    def _cap_pad(self, kind: str):
+        """Padding value for unoccupied capture slots: STR pads with the
+        NONE_ID so the formatter renders None, everything else zeros."""
+        return -1 if kind == STR else 0
+
+    def init_state(self):
+        K, R = self.cfg.key_capacity, self.R
+        state = {
+            "occ": jnp.zeros((K, R), dtype=bool),
+            "start_ts": jnp.full((K, R), W0, dtype=jnp.int64),
+        }
+        for i, kind in enumerate(self.mid_kinds):
+            state[f"cap{i}"] = jnp.full(
+                (K, R, R), self._cap_pad(kind), dtype=NUMPY_DTYPES[kind]
+            )
+        for name in (
+            "cep_matches", "cep_timeouts", "cep_partials",
+            "late_dropped", "alert_overflow", "exchange_overflow",
+        ):
+            state[name] = jnp.zeros((), dtype=jnp.int64)
+        state["wm"] = jnp.asarray(W0, dtype=jnp.int64)
+        state["max_ts"] = jnp.asarray(W0, dtype=jnp.int64)
+        return state
+
+    # ------------------------------------------------------------------
+    def _advance_round(self, sel, sk_c, sts, s_ok, s_cols, occ, start, caps):
+        """One arrival-rank round: apply each selected row's event to its
+        key's register file (vectorized over the batch/key axis).
+
+        Returns (new occ/start/caps, match mask [B], match event columns
+        [B, L] per visible field) — match outputs are nonzero only on
+        ``sel & match`` rows, which belong exclusively to this round."""
+        L, R = self.L, self.R
+        strict = self.compiled.strict  # numpy bools -> unrolled branches
+        kloc = occ.shape[0]
+        occ_g = occ[sk_c]              # [B, R]
+        st_g = start[sk_c]             # [B, R]
+        cap_g = [c[sk_c] for c in caps]  # [B, R, R] each
+
+        # can_adv[j]: edge j (state j -> j+1) fires off the pre-event
+        # snapshot; the start state (j == 0) is always active and a run
+        # beginning at this event trivially satisfies within
+        can_adv: List = [None] * L
+        for j in range(L):
+            src_occ = occ_g[:, j - 1] if j > 0 else jnp.ones_like(sel)
+            ok = src_occ & s_ok[:, j]
+            if self.within_ms is not None and j > 0:
+                ok = ok & ((sts - st_g[:, j - 1]) < self.within_ms)
+            can_adv[j] = ok
+
+        # resolve collisions top-down: an accepted advance consumes its
+        # source; an occupied target that neither advanced out nor died
+        # keeps its OLDER partial and rejects the incoming advance;
+        # strict sources die when their event failed to move them
+        adv_acc: List = [None] * L
+        adv_acc[L - 1] = can_adv[L - 1]  # accept state: always emits
+        keep_old: List = [None] * R
+        for i in range(R - 1, -1, -1):
+            consumed = adv_acc[i + 1]
+            # a strict register survives only by advancing (killed
+            # otherwise); a relaxed one survives unless consumed
+            if strict[i + 1]:
+                keep = jnp.zeros_like(consumed)
+            else:
+                keep = occ_g[:, i] & ~consumed
+            keep_old[i] = keep
+            adv_acc[i] = can_adv[i] & ~keep
+
+        match = adv_acc[L - 1]
+
+        # new register values (only sel rows scatter back)
+        new_occ = jnp.stack(
+            [keep_old[i] | adv_acc[i] for i in range(R)], axis=1
+        )
+        new_start = jnp.stack(
+            [
+                jnp.where(
+                    adv_acc[i], sts if i == 0 else st_g[:, i - 1], st_g[:, i]
+                )
+                for i in range(R)
+            ],
+            axis=1,
+        )
+        new_caps = []
+        for c, (g, col) in enumerate(zip(cap_g, s_cols)):
+            regs = []
+            for i in range(R):
+                src = g[:, i - 1, :] if i > 0 else g[:, i, :]
+                reg = src.at[:, i].set(col)
+                regs.append(jnp.where(adv_acc[i][:, None], reg, g[:, i, :]))
+            new_caps.append(jnp.stack(regs, axis=1))
+
+        idx = jnp.where(sel, sk_c, kloc)  # non-selected rows drop
+        occ = occ.at[idx].set(new_occ, mode="drop", unique_indices=True)
+        start = start.at[idx].set(new_start, mode="drop", unique_indices=True)
+        caps = [
+            c.at[idx].set(nc, mode="drop", unique_indices=True)
+            for c, nc in zip(caps, new_caps)
+        ]
+        # matched event columns [B, L]: captures of the final register
+        # (events 0..L-2) plus the completing event
+        m_cols = [
+            jnp.concatenate([g[:, R - 1, :], col[:, None]], axis=1)
+            for g, col in zip(cap_g, s_cols)
+        ]
+        return occ, start, caps, sel & match, m_cols
+
+    # ------------------------------------------------------------------
+    def _step(self, state, cols, valid, ts, wm_lower):
+        L, R = self.L, self.R
+        C = len(self.mid_kinds)
+        mid_cols, mask = self._apply_pre(cols, valid)
+
+        wm_old = state["wm"]
+        batch_max = self._global_max(jnp.max(jnp.where(mask, ts, W0)))
+        new_max = jnp.maximum(state["max_ts"], batch_max)
+        wm_new = jnp.maximum(
+            wm_old, jnp.maximum(new_max - self.delay_ms, wm_lower)
+        )
+
+        mid_cols, mask, ts, xovf = self._exchange(mid_cols, mask, ts)
+        mid_cols, key_col = self._split_key_col(mid_cols)
+        keys = self._local_keys(key_col)
+
+        late = mask & ((ts + self.allowed_lateness_ms) <= wm_old)
+        live = mask & ~late
+
+        # stage conditions vectorized over the whole batch, then the
+        # per-step transition bits via the compiled table's stage_of
+        # gather (the dense one-hot lowering of the NFA alphabet)
+        stage_ok = [f(mid_cols) for f in self._conds]
+        step_ok = jnp.stack(
+            [stage_ok[int(self.compiled.stage_of[j])] for j in range(L)],
+            axis=1,
+        )
+
+        kloc = state["occ"].shape[0]
+        perm, sk, sv, seg_starts = sort_by_key(keys, live, max_key=kloc)
+        ranks = segment_ranks(seg_starts)
+        n_rounds = jnp.max(jnp.where(sv, ranks + 1, 0))
+        sk_c = jnp.clip(sk, 0, kloc - 1)
+        sts = ts[perm]
+        s_ok = step_ok[perm]
+        s_cols = [c[perm] for c in mid_cols]
+        B = sv.shape[0]
+
+        def v(x):
+            return pane_ops.vary(x, self.vary_axes)
+
+        caps0 = tuple(state[f"cap{i}"] for i in range(C))
+        carry0 = (
+            jnp.zeros((), dtype=jnp.int32),
+            state["occ"],
+            state["start_ts"],
+            caps0,
+            v(jnp.zeros((B,), dtype=bool)),
+            tuple(v(jnp.zeros((B, L), dtype=c.dtype)) for c in s_cols),
+        )
+
+        def cond(carry):
+            return carry[0] < n_rounds
+
+        def body(carry):
+            r, occ, start, caps, m_mask, m_cols = carry
+            sel = sv & (ranks == r)
+            occ, start, caps, matched, mc = self._advance_round(
+                sel, sk_c, sts, s_ok, s_cols, occ, start, list(caps)
+            )
+            m_mask = m_mask | matched
+            m_cols = tuple(
+                jnp.where(matched[:, None], c_new, c_old)
+                for c_new, c_old in zip(mc, m_cols)
+            )
+            return (r + 1, occ, start, tuple(caps), m_mask, m_cols)
+
+        _, occ, start_ts_, caps, m_mask, m_cols = jax.lax.while_loop(
+            cond, body, carry0
+        )
+
+        # matches back to arrival order, flattened event-major, through
+        # the device post chain (select adapter + user map/filter), then
+        # compacted into the alert prefix buffer
+        inv = inverse_permutation(perm)
+        m_mask_o = m_mask[inv]
+        flat_cols = []
+        m_unperm = [c[inv] for c in m_cols]
+        for e in range(L):
+            for c in range(C):
+                flat_cols.append(m_unperm[c][:, e])
+        out_cols, keep = self.post_chain.apply(flat_cols, m_mask_o)
+        n_shards = max(1, self.cfg.parallelism)
+        gkey = self._global_key_ids(jnp.clip(keys, 0, kloc - 1))
+        _, emit_valid, ovf, gathered = pane_ops.compact(
+            keep, list(out_cols) + [gkey, ts], self.cfg.alert_capacity
+        )
+        main = {
+            "mask": emit_valid,
+            "cols": tuple(gathered[:-2]),
+            "subtask": gathered[-2] % n_shards,
+            # completing event's timestamp (Flink's match timestamp):
+            # chained event-time stages consume it downstream
+            "ts": gathered[-1],
+        }
+
+        emissions = {
+            "main": main,
+            "late": {"mask": late, "cols": tuple(mid_cols)},
+        }
+
+        # within() timeouts: partials whose window the NEW watermark
+        # passed can never complete (any extending event would now be
+        # late beyond allowed lateness) — emit and clear
+        n_tmo = jnp.zeros((), dtype=jnp.int64)
+        t_ovf = jnp.zeros((), dtype=jnp.int64)
+        if self.within_ms is not None:
+            tmo = occ & (wm_new >= (start_ts_ + self.within_ms))
+            flat = tmo.reshape(-1)                       # [K*R]
+            reg_idx = jnp.broadcast_to(
+                jnp.arange(R, dtype=jnp.int64)[None, :], (kloc, R)
+            ).reshape(-1)
+            t_cols = [
+                reg_idx + 1,                             # n_matched
+                start_ts_.reshape(-1),                   # start_ts
+            ]
+            for c in range(C):
+                kind = self.mid_kinds[c]
+                plane = caps[c].reshape(kloc * R, R)
+                for e in range(R):
+                    # zero slots past the register's capture count so the
+                    # emitted padding is deterministic (oracle-matchable)
+                    col = jnp.where(
+                        reg_idx >= e, plane[:, e], self._cap_pad(kind)
+                    )
+                    # timeout record is slot-major like the match record
+                    t_cols.append(col)
+            # reorder capture fields event-major: (slot e, field c)
+            head, tail = t_cols[:2], t_cols[2:]
+            ordered = [tail[c * R + e] for e in range(R) for c in range(C)]
+            _, t_valid, t_ovf, t_gathered = pane_ops.compact(
+                flat, head + ordered, self.cfg.alert_capacity
+            )
+            emissions["timeout"] = {
+                "mask": t_valid,
+                "cols": tuple(t_gathered),
+            }
+            occ = occ & ~tmo
+            n_tmo = self._global_sum(jnp.sum(tmo).astype(jnp.int64))
+
+        new_state = {"occ": occ, "start_ts": start_ts_}
+        for i in range(C):
+            new_state[f"cap{i}"] = caps[i]
+        new_state.update(
+            wm=wm_new,
+            max_ts=new_max,
+            cep_matches=state["cep_matches"]
+            + self._global_sum(jnp.sum(m_mask).astype(jnp.int64)),
+            cep_timeouts=state["cep_timeouts"] + n_tmo,
+            # point-in-time active-partial gauge (OBS_STATE_SCALARS)
+            cep_partials=self._global_sum(jnp.sum(occ).astype(jnp.int64)),
+            late_dropped=state["late_dropped"]
+            + (
+                self._global_sum(jnp.sum(late).astype(jnp.int64))
+                if self.count_late_as_dropped
+                else 0
+            ),
+            alert_overflow=state["alert_overflow"]
+            + self._global_sum(ovf + t_ovf),
+            exchange_overflow=state["exchange_overflow"]
+            + self._global_sum(xovf),
+        )
+        return new_state, emissions

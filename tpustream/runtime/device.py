@@ -57,7 +57,13 @@ def wrap_record(kinds: Sequence[str], tables: Sequence[Optional[StringTable]], s
         StrVal(s, t) if k == STR else s
         for k, t, s in zip(kinds, tables, scalars)
     ]
-    return vals[0] if len(vals) == 1 else make_tuple(*vals)
+    if len(vals) == 1:
+        return vals[0]
+    if len(vals) <= 4:
+        return make_tuple(*vals)
+    # wider than Tuple4 (e.g. a CEP flat match record of L*C fields):
+    # a plain tuple — unwrap_record and the select adapter accept it
+    return tuple(vals)
 
 
 def unwrap_record(rec) -> Tuple[list, list, list]:

@@ -578,6 +578,7 @@ class Runner:
     _COUNTER_NAMES = (
         "window_fires", "late_dropped", "alert_overflow",
         "exchange_overflow", "buffer_overflow", "evicted_unfired",
+        "cep_matches", "cep_timeouts",
     )
 
     def snapshot_counter_baseline(self):
@@ -937,6 +938,8 @@ class Runner:
         self._ensure_step()
         if self._fault is not None:
             self._fault("device_step")
+            if self.program.operator_name == "cep":
+                self._fault("cep_step")
             if self.program.n_shards > 1:
                 self._fault("exchange")
         packed, bases, valid, ts_p, ts_b = inputs
@@ -1313,9 +1316,16 @@ class Runner:
         host-side count scalars (skip empty streams; slice prefix-
         compacted buffers to ~count rows)."""
         fetch = {}
+        tt = getattr(self.program, "timeout_tag", None)
         for name, stream in emissions.items():
             c = cnts.get(name, 1)
             if not c or (name == "late" and not self.side_sinks):
+                continue
+            if name == "timeout" and (
+                tt is None or tt.id not in self.side_sinks
+            ):
+                # within()-expired partials are counted on device
+                # (cep_timeouts) even when no side output consumes them
                 continue
             if (
                 name == "main"
@@ -1636,6 +1646,9 @@ class Runner:
         late = emissions.get("late")
         if late is not None and self.side_sinks:
             self._dispatch_late(late)
+        timeout = emissions.get("timeout")
+        if timeout is not None:
+            self._dispatch_timeout(timeout)
         emitted_delta = self.metrics.records_emitted - emitted_before
         if emitted_delta:
             self.obs.records_emitted.inc(emitted_delta)
@@ -1656,11 +1669,36 @@ class Runner:
         fmt = EmissionFormatter(
             self.program.mid_kinds, self.program.mid_tables
         )
-        for ops, sink in self.side_sinks.values():
+        # the CEP timeout tag's sink receives ONLY the timeout stream
+        tt = getattr(self.program, "timeout_tag", None)
+        for tag_id, (ops, sink) in self.side_sinks.items():
+            if tt is not None and tag_id == tt.id:
+                continue
             for row in fmt.rows(cols):
                 item, keep = _apply_ops(ops, row)
                 if keep:
                     sink.emit(item)
+
+    def _dispatch_timeout(self, timeout):
+        """Route within()-expired partial matches to the pattern's
+        timeout side output (Flink's PatternTimeoutFunction stream)."""
+        tt = getattr(self.program, "timeout_tag", None)
+        entry = self.side_sinks.get(tt.id) if tt is not None else None
+        if entry is None:
+            return
+        mask = np.asarray(timeout["mask"])
+        sel = np.nonzero(mask)[0]
+        if not sel.size:
+            return
+        cols = [np.asarray(c)[sel] for c in timeout["cols"]]
+        fmt = EmissionFormatter(
+            self.program.timeout_kinds, self.program.timeout_tables
+        )
+        ops, sink = entry
+        for row in fmt.rows(cols):
+            item, keep = _apply_ops(ops, row)
+            if keep:
+                sink.emit(item)
 
 
 def _reject_count_ts(st):

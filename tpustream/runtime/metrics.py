@@ -80,6 +80,10 @@ class Metrics:
         "exchange_overflow",
         "buffer_overflow",
         "evicted_unfired",
+        # CEP: completed pattern matches / within()-expired partials
+        # (device-accumulated, folded at finalize like window_fires)
+        "cep_matches",
+        "cep_timeouts",
     )
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
@@ -120,6 +124,8 @@ class Metrics:
             "exchange_overflow": self.exchange_overflow,
             "buffer_overflow": self.buffer_overflow,
             "evicted_unfired": self.evicted_unfired,
+            "cep_matches": self.cep_matches,
+            "cep_timeouts": self.cep_timeouts,
             "device_time_s": total_step,
             "host_time_s": sum(self.host_times_s),
             "events_per_sec_device": (

@@ -173,6 +173,10 @@ class StatefulSpec:
     apply_fn: Optional[Any] = None
     allowed_lateness_ms: int = 0
     late_tag: Optional[OutputTag] = None
+    # cep: the CompiledPattern (tpustream/cep/nfa.py) and the side output
+    # receiving within()-expired partial matches
+    cep: Optional[Any] = None
+    timeout_tag: Optional[OutputTag] = None
 
 
 @dataclass
@@ -425,6 +429,29 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
             )
             pending_window = None
             continue
+        if op == "cep":
+            if key_pos is None:
+                raise RuntimeError(
+                    "CEP.pattern requires a keyed stream: call key_by first"
+                )
+            from ..cep.nfa import compile_pattern
+            from ..cep.pattern import make_select_adapter
+
+            compiled = compile_pattern(node.params["pattern"])
+            stateful = StatefulSpec(
+                "cep",
+                cep=compiled,
+                allowed_lateness_ms=node.params.get("allowed_lateness_ms", 0),
+                late_tag=node.params.get("late_tag"),
+                timeout_tag=node.params.get("timeout_tag"),
+            )
+            sel_fn = node.params.get("select_fn")
+            if sel_fn is not None:
+                # the select adapter is the FIRST post op: user map/
+                # filter tails see the selected record, not the raw
+                # L*C flat match
+                device_post.append(("map", make_select_adapter(compiled, sel_fn)))
+            continue
         raise NotImplementedError(f"operator {op} not supported in this chain")
 
     # side outputs: ops between the side_output node and the sink
@@ -602,6 +629,26 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
                 late_tag=pending_window.params.get("late_tag"),
             )
             pending_window = None
+            continue
+        if op == "cep":
+            if key_pos is None:
+                raise RuntimeError(
+                    "CEP.pattern requires a keyed stream: call key_by first"
+                )
+            from ..cep.nfa import compile_pattern
+            from ..cep.pattern import make_select_adapter
+
+            compiled = compile_pattern(node.params["pattern"])
+            stateful = StatefulSpec(
+                "cep",
+                cep=compiled,
+                allowed_lateness_ms=node.params.get("allowed_lateness_ms", 0),
+                late_tag=node.params.get("late_tag"),
+                timeout_tag=node.params.get("timeout_tag"),
+            )
+            sel_fn = node.params.get("select_fn")
+            if sel_fn is not None:
+                device_post.append(("map", make_select_adapter(compiled, sel_fn)))
             continue
         raise NotImplementedError(
             f"operator {op} is not supported in a chained stage"

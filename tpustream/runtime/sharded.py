@@ -30,6 +30,7 @@ except AttributeError:  # older jax: the experimental namespace, whose
 
 from ..parallel.exchange import exchange_by_key, exchange_capacity
 from ..parallel.mesh import AXIS, make_mesh
+from .cep_program import CepProgram
 from .count_program import (
     CountProcessProgram,
     CountWindowProgram,
@@ -174,6 +175,21 @@ class ShardedCountProcessProgram(_ShardedMixin, CountProcessProgram):
     """Count-window process() at parallelism N: emission payloads carry
     GLOBAL key ids and per-shard element matrices, so the host callback
     needs no shard-aware row mapping."""
+
+    def __init__(self, plan: JobPlan, cfg):
+        super().__init__(plan, cfg)
+        self._setup_sharding(cfg)
+
+    def jitted_step(self):
+        return self._sharded_jit()
+
+
+class ShardedCepProgram(_ShardedMixin, CepProgram):
+    """CEP NFA matching at parallelism N: the keyBy exchange routes
+    events to their key's owner shard, register/capture planes shard on
+    the key axis, watermarks agree via pmax, and match/timeout records
+    carry global key ids — the same advance loop runs unchanged per
+    shard under shard_map."""
 
     def __init__(self, plan: JobPlan, cfg):
         super().__init__(plan, cfg)

@@ -44,7 +44,13 @@ class EmissionFormatter:
                 converted.append([int(v) for v in col])
         for j in range(n):
             vals = tuple(c[j] for c in converted)
-            yield vals[0] if len(vals) == 1 else make_tuple(*vals)
+            if len(vals) == 1:
+                yield vals[0]
+            elif len(vals) <= 4:
+                yield make_tuple(*vals)
+            else:
+                # wider than Tuple4 (e.g. CEP timeout records): plain tuple
+                yield vals
 
 
 class PrintSink:

@@ -484,4 +484,12 @@ def build_program(plan: JobPlan, cfg: StreamConfig) -> BaseProgram:
         from .window_program import WindowProgram
 
         return WindowProgram(plan, cfg)
+    if plan.stateful.kind == "cep":
+        if sharded:
+            from .sharded import ShardedCepProgram
+
+            return ShardedCepProgram(plan, cfg)
+        from .cep_program import CepProgram
+
+        return CepProgram(plan, cfg)
     raise NotImplementedError(plan.stateful.kind)

@@ -9,6 +9,9 @@ paths named after them:
   supervisor, a malformed LINE is quarantined — see
   StreamConfig.dead_letter)
 * ``device_step``  — before each jitted step dispatch
+* ``cep_step``     — before each jitted step dispatch of a CEP (pattern
+  matching) program only: targets crash recovery of mid-pattern NFA
+  register state without also firing on the job's other operators
 * ``exchange``     — before a sharded (n_shards > 1) step's keyBy
   all_to_all
 * ``sink_emit``    — inside each sink emit attempt (so sink retry
@@ -36,6 +39,7 @@ FAULT_POINTS = (
     "source_read",
     "parse",
     "device_step",
+    "cep_step",
     "exchange",
     "sink_emit",
 )
