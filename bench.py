@@ -406,6 +406,64 @@ def recovery_probe():
     )
 
 
+def dynamic_rules_probe():
+    """Phase U: dynamic-rules propagation probe (docs/dynamic_rules.md).
+    Runs the chapter-5 dynamic-threshold job with a mid-stream broadcast
+    update and reports what a runtime rule change costs: the ingest ->
+    first-batch-under-new-rule latency series the executor mints
+    (``rule_update_propagation_ms``), the update/version counters, and
+    the zero-recompile proof (``operator_recompile_cause`` must show no
+    ``config_change`` builds). Documents a surface, not a rate."""
+    from tpustream import StreamExecutionEnvironment
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs.chapter5_dynamic_rules import (
+        build, control_lines, make_rules, oracle,
+    )
+    from tpustream.runtime.sources import ReplaySource
+
+    lines = [
+        f"15634520{j % 100:02d} 10.8.22.{j % 5} cpu{j % 3} "
+        f"{60 + (j * 13) % 40}.5"
+        for j in range(2048)
+    ]
+    updates = [(512, 95.0), (1536, 75.0)]
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=256, obs=ObsConfig(enabled=True))
+    )
+    rules = make_rules()
+    handle = build(
+        env,
+        env.add_source(ReplaySource(lines)),
+        env.add_source(ReplaySource(control_lines(updates))),
+        rules,
+    ).collect()
+    env.execute("dynamic-rules-probe")
+    series = env.metrics.obs_snapshot()["metrics"]["series"]
+
+    def pick(name, field=None):
+        for s in series:
+            if s["name"].endswith(name):
+                return s["value"][field] if field else s["value"]
+        return None
+
+    config_change_builds = sum(
+        s["value"]
+        for s in series
+        if s["name"] == "operator_recompile_cause"
+        and s["labels"].get("cause") == "config_change"
+    )
+    want = [tuple(t) for t in oracle(lines, updates)]
+    got = [tuple(t) for t in handle.items]
+    return dict(
+        updates_applied=pick("rule_updates_total"),
+        rule_version=pick("rule_version"),
+        propagation_ms_p50=pick("rule_update_propagation_ms", "p50"),
+        propagation_ms_p99=pick("rule_update_propagation_ms", "p99"),
+        config_change_recompiles=config_change_builds,
+        output_matches_oracle=got == want,
+    )
+
+
 def sustainable_rate(run_paced, r0, label, rtt_ms):
     """Rate -> p99 curve with stage attribution (VERDICT r4 next #1),
     walking a descending rate ladder from the flood throughput ``r0``.
@@ -1711,6 +1769,22 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase R skipped: {e}")
 
+    # ---- Phase U: dynamic-rules propagation probe -----------------------
+    dynamic_rules = None
+    try:
+        dynamic_rules = dynamic_rules_probe()
+        p50 = dynamic_rules["propagation_ms_p50"]
+        log(
+            f"phase U: {dynamic_rules['updates_applied']} broadcast rule "
+            f"update(s) propagated in p50 "
+            f"{p50 and round(p50, 2)} ms with "
+            f"{dynamic_rules['config_change_recompiles']} config_change "
+            f"recompile(s); output matches oracle: "
+            f"{dynamic_rules['output_matches_oracle']}"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase U skipped: {e}")
+
     print(
         json.dumps(
             {
@@ -1800,6 +1874,10 @@ def main():
                     # delivers after an injected mid-stream crash
                     # (docs/recovery.md)
                     "recovery": recovery,
+                    # phase U: what a runtime broadcast rule update
+                    # costs — propagation latency and the zero-recompile
+                    # proof (docs/dynamic_rules.md)
+                    "dynamic_rules": dynamic_rules,
                     # and its device-side registries, folded: what XLA
                     # built (count/cause/wall/cost) and what the state
                     # pytree costs in HBM per operator/component

@@ -27,6 +27,7 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -130,6 +131,31 @@ _SMOKE_TESTS = {
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: heavy fuzz/mesh/compile tests")
     config.addinivalue_line("markers", "smoke: <60s representative subset")
+    config.addinivalue_line(
+        "markers",
+        "fresh_cache: run against a cold per-test XLA compilation cache "
+        "(this jax/XLA CPU build intermittently segfaults executing a "
+        "cache-deserialized executable against donated buffers — the "
+        "test_key_growth.py pattern, opt-in per test/file)",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_compilation_cache_marker(request, tmp_path):
+    """Honor ``@pytest.mark.fresh_cache``: swap the persistent XLA
+    compilation cache for a cold per-test directory so every dispatch
+    runs the freshly built in-memory executable (dynamic-rules tests
+    re-dispatch donated-buffer programs many times per run). Unmarked
+    tests see no change."""
+    if request.node.get_closest_marker("fresh_cache") is None:
+        yield
+        return
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "cc"))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,431 @@
+"""Dynamic rules via broadcast state (tpustream/broadcast,
+docs/dynamic_rules.md): runtime-updatable operator parameters as device
+data. The contracts pinned here:
+
+* record-exact, batch-size-independent update semantics — a data batch
+  straddling an update position is split there (records before position
+  N run under the old rules, records at/after N under the new), checked
+  against a host oracle across batch sizes;
+* ZERO recompiles per update — a rule swap is an HBM buffer swap, and
+  the obs compile registry must show no ``config_change`` builds;
+* the update applies atomically at the same boundary on single-chip and
+  the p=8 mesh (identical outputs — the rule leaves replicate);
+* a CEP predicate constant changes mid-stream without recompiling the
+  NFA step;
+* the active rule version survives an injected ``control_apply`` crash
+  with byte-identical recovered output, and rides the checkpoint meta.
+"""
+
+import pytest
+
+from tpustream import (
+    CEP,
+    Pattern,
+    RuleSet,
+    RuleUpdate,
+    StreamExecutionEnvironment,
+    TimeCharacteristic,
+    Tuple2,
+)
+from tpustream.broadcast import ControlFeed, parse_control_line
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.javacompat import Double
+from tpustream.jobs.chapter5_dynamic_rules import (
+    build as build_ch5,
+    control_lines,
+    make_rules,
+    oracle,
+)
+from tpustream.runtime.sources import ReplaySource
+from tpustream.runtime.supervisor import fixed_delay
+from tpustream.testing import FaultInjector, FaultPoint
+
+# dynamic-rules runs re-dispatch donated-buffer executables many times
+# per test; run them against a cold per-test compilation cache (the
+# test_key_growth.py segfault-avoidance pattern, via conftest marker)
+pytestmark = pytest.mark.fresh_cache
+
+# usage in [60.5, 99.5]: some records alert at threshold 90, different
+# ones after an update
+LINES = [
+    f"15634520{j % 100:02d} 10.8.22.{j % 5} cpu{j % 3} {60 + (j * 13) % 40}.5"
+    for j in range(40)
+]
+
+
+def run_ch5(
+    lines, updates, batch_size=4, ckdir=None, injector=None,
+    strategy=None, **over,
+):
+    """One chapter-5 dynamic-threshold run; returns (result, tuples, rules)."""
+    cfg = StreamConfig(batch_size=batch_size, **over)
+    if ckdir is not None:
+        cfg = cfg.replace(
+            checkpoint_dir=str(ckdir), checkpoint_interval_batches=1
+        )
+    if injector is not None:
+        cfg = injector.install(cfg)
+    env = StreamExecutionEnvironment(cfg)
+    if strategy is not None:
+        env.set_restart_strategy(strategy)
+    rules = make_rules()
+    handle = build_ch5(
+        env,
+        env.add_source(ReplaySource(lines)),
+        env.add_source(ReplaySource(control_lines(updates))),
+        rules,
+    ).collect()
+    res = env.execute("dyn-rules-test")
+    return res, [tuple(t) for t in handle.items], rules
+
+
+def expect_ch5(lines, updates):
+    return [tuple(t) for t in oracle(lines, updates)]
+
+
+# ---------------------------------------------------------------------------
+# record-exact update semantics vs the host oracle
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("batch_size", [3, 4, 16, 64])
+def test_threshold_update_matches_oracle(batch_size):
+    """One mid-stream raise: records before position 17 filter at 90,
+    records from 17 on at 95 — exact at every batch size (17 straddles
+    every batch layout tried here)."""
+    updates = [(17, 95.0)]
+    _, got, rules = run_ch5(LINES, updates, batch_size=batch_size)
+    assert got == expect_ch5(LINES, updates)
+    assert rules.version == 1
+    assert rules.value("threshold") == 95.0
+
+
+def test_multiple_updates_single_batch():
+    """Two updates landing INSIDE one 16-row batch: the batch splits
+    twice, three rule regimes inside one source batch."""
+    updates = [(5, 95.0), (9, 70.0)]
+    _, got, rules = run_ch5(LINES, updates, batch_size=16)
+    assert got == expect_ch5(LINES, updates)
+    assert rules.version == 2
+
+
+def test_update_before_and_after_stream():
+    """Position 0 applies before the first record; a position past the
+    last record still applies (it governs the final rule state) without
+    touching any output."""
+    updates = [(0, 75.0), (10_000, 99.0)]
+    _, got, rules = run_ch5(LINES, updates, batch_size=8)
+    assert got == expect_ch5(LINES, updates)
+    assert rules.version == 2
+    assert rules.value("threshold") == 99.0
+
+
+def test_batch_size_invariance():
+    outs = [
+        run_ch5(LINES, [(13, 95.0), (29, 65.0)], batch_size=b)[1]
+        for b in (2, 5, 40)
+    ]
+    assert outs[0] == outs[1] == outs[2]
+    assert outs[0] == expect_ch5(LINES, [(13, 95.0), (29, 65.0)])
+
+
+# ---------------------------------------------------------------------------
+# zero recompiles + the obs surface
+# ---------------------------------------------------------------------------
+def test_rule_update_zero_recompiles_and_obs_series():
+    """The acceptance gate: a runtime threshold change causes NO
+    ``config_change`` recompile (the jitted step reads rules as data),
+    and the obs surface records it — rule_version gauge, a cumulative
+    update counter, the propagation-latency histogram, and a
+    ``rule_applied`` flight event carrying old/new versions."""
+    updates = [(17, 95.0)]
+    res, got, _ = run_ch5(
+        LINES, updates, batch_size=4, obs=ObsConfig(enabled=True)
+    )
+    assert got == expect_ch5(LINES, updates)
+    series = res.metrics.obs_snapshot()["metrics"]["series"]
+    config_change = [
+        s for s in series
+        if s["name"] == "operator_recompile_cause"
+        and s["labels"].get("cause") == "config_change"
+    ]
+    assert not config_change, config_change
+    by_name = {s["name"]: s for s in series if not s["labels"].get("cause")}
+    assert by_name["rule_version"]["value"] == 1
+    assert by_name["rule_updates_total"]["value"] == 1
+    assert by_name["rule_update_propagation_ms"]["value"]["count"] >= 1
+    applied = [
+        e for e in res.metrics.job_obs.flight.events()
+        if e["kind"] == "rule_applied"
+    ]
+    assert len(applied) == 1
+    assert applied[0]["old_version"] == 0
+    assert applied[0]["new_version"] == 1
+    assert applied[0]["rules"] == {"threshold": 95.0}
+
+
+# ---------------------------------------------------------------------------
+# a chapter-3-style window parameter, single-chip == p=8 mesh
+# ---------------------------------------------------------------------------
+def _kv_parse(s):
+    items = s.split(" ")
+    return Tuple2(items[0], Double.parseDouble(items[1]))
+
+
+def _run_window_param(updates, batch_size=4, parallelism=1):
+    """Chapter-3 shape with a dynamic post-window parameter: count
+    windows of 2 per key, sum, keep sums BELOW the dynamic limit (the
+    ``< 100 Mbps`` filter of chapter3_bandwidth.py made updatable).
+    Control records are RuleUpdate objects straight through the source
+    (the default parser passes them through)."""
+    rules = RuleSet()
+    limit = rules.declare("sum_limit", 10.0, "f64")
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=batch_size, parallelism=parallelism)
+    )
+    data = env.from_collection([f"k {i}" for i in range(12)])
+    ctrl = env.from_collection(
+        [RuleUpdate("sum_limit", v, pos) for pos, v in updates]
+    )
+    ctrl.broadcast(rules)
+    handle = (
+        data.map(_kv_parse)
+        .key_by(0)
+        .count_window(2)
+        .sum(1)
+        .filter(lambda t: t.f1 < limit)
+    ).collect()
+    env.execute("win-param-test")
+    return [tuple(t) for t in handle.items]
+
+
+def test_window_param_update_mid_stream():
+    # windows (pairs) sum to 1,5,9,13,17,21; limit 10 keeps 1,5,9.
+    # raising to 100 after record 6: the (6,7) window completes under
+    # the NEW limit, (4,5) completed under the old one
+    got = _run_window_param([(6, 100.0)], batch_size=4)
+    assert got == [("k", 1.0), ("k", 5.0), ("k", 9.0),
+                   ("k", 13.0), ("k", 17.0), ("k", 21.0)]
+    # and without the update the raised windows stay filtered
+    assert _run_window_param([], batch_size=4) == [
+        ("k", 1.0), ("k", 5.0), ("k", 9.0)
+    ]
+
+
+def test_window_param_p8_matches_single_chip():
+    """The p=8 parity gate: the rule leaves replicate over the mesh, so
+    every shard applies version N at the same record boundary and the
+    mesh output equals the single-chip output exactly."""
+    updates = [(6, 100.0)]
+    single = _run_window_param(updates, batch_size=8, parallelism=1)
+    mesh = _run_window_param(updates, batch_size=8, parallelism=8)
+    assert mesh == single
+    assert single == [("k", 1.0), ("k", 5.0), ("k", 9.0),
+                      ("k", 13.0), ("k", 17.0), ("k", 21.0)]
+
+
+def test_threshold_p8_matches_oracle_mid_batch():
+    """Chapter-5 job on the p=8 mesh with the update mid-batch (not on
+    a batch boundary): still record-exact, still equal to single-chip."""
+    updates = [(13, 95.0)]
+    _, single, _ = run_ch5(LINES, updates, batch_size=8)
+    _, mesh, _ = run_ch5(LINES, updates, batch_size=8, parallelism=8)
+    assert mesh == single == expect_ch5(LINES, updates)
+
+
+# ---------------------------------------------------------------------------
+# CEP: a dynamic predicate constant, no NFA recompile
+# ---------------------------------------------------------------------------
+def test_cep_dynamic_predicate_no_recompile():
+    """A CEP ``where`` predicate reads a rule: raising the constant
+    mid-stream changes which events match WITHOUT recompiling the NFA
+    step — the predicate traces against the rule leaf, and the compile
+    registry shows zero config_change builds."""
+    from tpustream import BoundedOutOfOrdernessTimestampExtractor, Time
+
+    class SecExtractor(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.seconds(0))
+
+        def extract_timestamp(self, element):
+            return int(element.split(" ")[0]) * 1000
+
+    rules = RuleSet()
+    thr = rules.declare("flow_min", 50.0, "f64")
+    # threshold 50 for positions 0-4, 75 from position 5 on:
+    # "two hot in a row" pairs are (60,80) under the old constant and
+    # (90,95) under the new; (70,55) at positions 4-5 straddles the
+    # update — 55 > 50 but NOT > 75, so that run must die, proving the
+    # predicate read each event's position-active value
+    vals = [30, 60, 80, 40, 70, 55, 90, 95, 20, 85]
+    lines = [f"{100 + i} ch {v}" for i, v in enumerate(vals)]
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, obs=ObsConfig(enabled=True))
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    ctrl = env.from_collection([RuleUpdate("flow_min", 75.0, 5)])
+    ctrl.broadcast(rules)
+    keyed = (
+        env.from_collection(lines)
+        .assign_timestamps_and_watermarks(SecExtractor())
+        .map(lambda s: Tuple2(s.split(" ")[1], float(s.split(" ")[2])))
+        .key_by(0)
+    )
+    pattern = (
+        Pattern.begin("a").where(lambda r: r.f1 > thr)
+        .next("b").where(lambda r: r.f1 > thr)
+    )
+    handle = CEP.pattern(keyed, pattern).select(
+        lambda m: m["b"][0].f1
+    ).collect()
+    res = env.execute("cep-dyn-test")
+    assert sorted(handle.items) == [80.0, 95.0]
+    series = res.metrics.obs_snapshot()["metrics"]["series"]
+    config_change = [
+        s for s in series
+        if s["name"] == "operator_recompile_cause"
+        and s["labels"].get("cause") == "config_change"
+    ]
+    assert not config_change, config_change
+
+
+# ---------------------------------------------------------------------------
+# durability: checkpoint meta + control_apply crash recovery
+# ---------------------------------------------------------------------------
+def test_checkpoint_meta_carries_rules(tmp_path):
+    import glob
+    import os
+
+    from tpustream.runtime.checkpoint import load_checkpoint
+
+    updates = [(5, 95.0)]
+    run_ch5(LINES, updates, batch_size=4, ckdir=tmp_path)
+    snaps = sorted(glob.glob(os.path.join(str(tmp_path), "ckpt-*.npz")))
+    assert snaps
+    ck = load_checkpoint(snaps[-1])
+    assert ck.rule_values == {"threshold": 95.0}
+    assert ck.rule_version == 1
+    # and an early snapshot (if still retained) predates the update
+    first = load_checkpoint(snaps[0])
+    assert first.rule_version in (0, 1)
+
+
+def test_control_apply_crash_recovers_byte_identical(tmp_path):
+    """The new fault point: crash in the window between rule
+    application and the next data batch. The supervised restart restores
+    the pre-update rule version from the checkpoint, replays, re-applies
+    the update at the SAME record boundary — output byte-identical to an
+    uninterrupted run, final version exactly 1 (no double-apply)."""
+    updates = [(17, 95.0)]
+    want = expect_ch5(LINES, updates)
+    _, clean, _ = run_ch5(LINES, updates, batch_size=4)
+    assert clean == want
+
+    inj = FaultInjector(FaultPoint("control_apply", at=0))
+    _, got, rules = run_ch5(
+        LINES, updates, batch_size=4, ckdir=tmp_path,
+        injector=inj, strategy=fixed_delay(3, 0.0),
+    )
+    assert inj.fired == 1
+    assert got == want
+    assert rules.version == 1
+    assert rules.value("threshold") == 95.0
+
+
+def test_scratch_restart_replays_rule_timeline(tmp_path):
+    """A crash BEFORE any checkpoint exists restarts from scratch: the
+    RuleSet resets to its defaults and the control feed re-applies the
+    update at its original boundary — still byte-identical."""
+    updates = [(17, 95.0)]
+    want = expect_ch5(LINES, updates)
+    inj = FaultInjector(FaultPoint("device_step", at=0))
+    _, got, rules = run_ch5(
+        LINES, updates, batch_size=4,
+        injector=inj, strategy=fixed_delay(3, 0.0),
+    )
+    assert inj.fired == 1
+    assert got == want
+    assert rules.version == 1
+
+
+# ---------------------------------------------------------------------------
+# unit surface: RuleSet / parser / feed cursor / API guards
+# ---------------------------------------------------------------------------
+def test_ruleset_coercion_and_reset():
+    rules = RuleSet()
+    f = rules.declare("f", 1.5, "f64")
+    i = rules.declare("i", 2, "i64")
+    b = rules.declare("b", True, "bool")
+    rules.apply(RuleUpdate("f", "3.25"))
+    rules.apply(RuleUpdate("i", "95.0"))   # text i64 goes through float
+    rules.apply(RuleUpdate("b", "false"))  # "false" must NOT be truthy
+    assert rules.value("f") == 3.25
+    assert rules.value("i") == 95
+    assert rules.value("b") is False
+    assert rules.version == 3
+    assert float(f) == 3.25 and int(i) == 95 and bool(b) is False
+    rules.reset()
+    assert rules.version == 0
+    assert (rules.value("f"), rules.value("i"), rules.value("b")) == (
+        1.5, 2, True
+    )
+    # javacompat aliases
+    assert rules.getValue("i") == 2
+    assert rules.getVersion() == 0
+    assert rules.getParam("f").name == "f"
+    with pytest.raises(ValueError):
+        rules.declare("f", 0.0)  # duplicate
+    with pytest.raises(KeyError):
+        rules.value("nope")
+
+
+def test_parse_control_line():
+    assert parse_control_line("threshold 95 10") == RuleUpdate(
+        "threshold", "95", 10
+    )
+    assert parse_control_line(b"threshold 95") == RuleUpdate(
+        "threshold", "95", 0
+    )
+    assert parse_control_line("") is None
+    assert parse_control_line("# comment") is None
+    u = RuleUpdate("x", 1, 2)
+    assert parse_control_line(u) is u
+    with pytest.raises(ValueError):
+        parse_control_line("just-a-name")
+
+
+def test_control_feed_cursor_and_splits():
+    rules = RuleSet()
+    rules.declare("t", 90.0)
+    feed = ControlFeed(rules)
+    feed.add(RuleUpdate("t", 95.0, 10))
+    feed.add(RuleUpdate("t", 80.0, 4))
+    feed.add(RuleUpdate("t", 70.0, 10))
+    # sorted by position; same-position updates keep arrival order
+    assert [u.after_records for u in feed.pending()] == [4, 10, 10]
+    splits = feed.splits_for(8, 8)  # batch covers records [8, 16)
+    assert [(off, [u.value for u in us]) for off, us in splits] == [
+        (0, [80.0]),       # position 4 is already past: apply first
+        (2, [95.0, 70.0]),  # position 10 -> offset 2
+    ]
+    # applying advances the cursor: version counts applied updates
+    for _, us in splits:
+        for u in us:
+            rules.apply(u)
+    assert feed.pending() == []
+    assert rules.value("t") == 70.0
+
+
+def test_one_broadcast_per_job():
+    rules = RuleSet()
+    rules.declare("t", 1.0)
+    env = StreamExecutionEnvironment(StreamConfig())
+    env.from_collection([]).broadcast(rules)
+    with pytest.raises(RuntimeError, match="one broadcast"):
+        env.from_collection([]).broadcast(rules)
+
+
+def test_broadcast_requires_source_stream():
+    rules = RuleSet()
+    rules.declare("t", 1.0)
+    env = StreamExecutionEnvironment(StreamConfig())
+    with pytest.raises(NotImplementedError):
+        env.from_collection([]).map(lambda x: x).broadcast(rules)

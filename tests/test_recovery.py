@@ -443,3 +443,76 @@ def test_checkpoint_meta_records_recovery_fields(tmp_path):
     assert ck.sink_counts[0] == ck.emitted  # single collect sink
     assert ck.quarantined == 0
     assert ck.session is None  # written outside supervision
+
+
+def _rewrite_format_version(path, version):
+    """Rewrite a snapshot's meta version in place (payload untouched, so
+    the checksum stays valid — ONLY the format version mismatches),
+    simulating a snapshot written by a pre-bump build."""
+    import numpy as np
+
+    from tpustream.runtime.checkpoint import _META_KEY
+
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+    meta["version"] = version
+    with open(path, "wb") as f:
+        np.savez(f, **arrays, **{_META_KEY: np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)})
+
+
+def test_mixed_version_directory_skips_older_format(tmp_path):
+    """A checkpoint directory straddling a format bump (regression for
+    the v9 dynamic-rules bump): ``latest_checkpoint`` must treat
+    current-FORMAT_VERSION snapshots as valid while skipping the
+    older-version ones with a ``checkpoint_skipped`` breadcrumb — never
+    handing the supervisor an unloadable path."""
+    from tpustream.runtime.checkpoint import FORMAT_VERSION
+
+    run_supervised(LINES, ckdir=tmp_path)
+    snaps = _snaps(tmp_path)
+    assert len(snaps) >= 2
+    newest, older = snaps[-1], snaps[-2]
+    # this build's snapshots ARE the current format — valid as written
+    assert validate_checkpoint(newest) is None
+    _rewrite_format_version(newest, FORMAT_VERSION - 1)
+    reason = validate_checkpoint(newest)
+    assert reason is not None and "version" in reason
+
+    class Ring:
+        def __init__(self):
+            self.events = []
+
+        def record(self, kind, **payload):
+            self.events.append((kind, payload))
+
+    ring = Ring()
+    picked = latest_checkpoint(str(tmp_path), flight=ring)
+    assert picked == older
+    assert validate_checkpoint(picked) is None
+    assert any(
+        k == "checkpoint_skipped"
+        and p["path"] == newest
+        and "version" in p["reason"]
+        for k, p in ring.events
+    )
+
+
+def test_recovery_survives_mixed_version_directory(tmp_path):
+    """End to end: the newest snapshot is from an older format (a
+    pre-upgrade run left it behind), the job crashes — the restart
+    restores from the newest CURRENT-version snapshot and the output is
+    still byte-identical to an uninterrupted run."""
+    from tpustream.runtime.checkpoint import FORMAT_VERSION
+
+    _, full, _ = run_supervised(LINES)
+    run_supervised(LINES, ckdir=tmp_path)
+    _rewrite_format_version(_snaps(tmp_path)[-1], FORMAT_VERSION - 1)
+
+    inj = FaultInjector(FaultPoint("device_step", at=2))
+    _, out, _ = run_supervised(
+        LINES, ckdir=tmp_path, strategy=fixed_delay(3, 0.0), injector=inj,
+    )
+    assert inj.fired == 1
+    assert out == full

@@ -44,6 +44,13 @@ from .api.functions import (  # noqa: E402
     ReduceFunction,
 )
 from .api.output import OutputTag  # noqa: E402
+from .broadcast import (  # noqa: E402
+    BroadcastStream,
+    RuleDescriptor,
+    RuleParam,
+    RuleSet,
+    RuleUpdate,
+)
 from .cep import CEP, Pattern, PatternSelectFunction  # noqa: E402
 from .config import StreamConfig  # noqa: E402
 from .runtime.supervisor import RestartStrategies  # noqa: E402
@@ -54,6 +61,7 @@ __all__ = [
     "AggregateFunction",
     "AssignerWithPeriodicWatermarks",
     "BoundedOutOfOrdernessTimestampExtractor",
+    "BroadcastStream",
     "CEP",
     "FilterFunction",
     "KeySelector",
@@ -64,6 +72,10 @@ __all__ = [
     "ProcessWindowFunction",
     "ReduceFunction",
     "RestartStrategies",
+    "RuleDescriptor",
+    "RuleParam",
+    "RuleSet",
+    "RuleUpdate",
     "StreamConfig",
     "StreamExecutionEnvironment",
     "Time",

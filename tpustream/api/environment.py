@@ -29,6 +29,10 @@ class StreamExecutionEnvironment:
         # the job; survives supervised restarts (rolled back with the
         # sinks on recovery so counts stay exactly-once)
         self.dead_letters: list = []
+        # dynamic-rules control stream (DataStream.broadcast): ONE
+        # BroadcastStream per job; its RuleSet threads through every
+        # program of the plan chain (tpustream/broadcast)
+        self._broadcast = None
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -104,6 +108,14 @@ class StreamExecutionEnvironment:
     # -- execution -----------------------------------------------------------
     def _register_sink(self, node: Node) -> None:
         self._sinks.append(node)
+
+    def _register_broadcast(self, bs) -> None:
+        if self._broadcast is not None:
+            raise RuntimeError(
+                "a job supports one broadcast control stream; declare "
+                "all dynamic parameters in one RuleSet"
+            )
+        self._broadcast = bs
 
     def execute(self, job_name: str = "tpustream job"):
         """Phase B: plan, compile, and run the job to source exhaustion.

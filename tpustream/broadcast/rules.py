@@ -1,0 +1,258 @@
+"""Dynamic rules: operator parameters as device data, not trace constants.
+
+The reference tutorial bakes every threshold into the job at build time
+(``usage > 90`` at chapter1/.../Main.java:27-33); Flink's production
+answer is broadcast state — a control stream whose rule updates reach
+every parallel instance and are checkpointed with the job. Here the
+runtime half of that pattern: a :class:`RuleSet` declares named dynamic
+parameters, each materialized as a 0-d device array riding the program's
+state pytree (``state["__rules__"][name]``). User functions hold a
+:class:`RuleParam` handle that resolves *contextually*:
+
+* inside the jitted step trace (``RuleSet.bound`` active) it resolves to
+  the traced state leaf, so ``value.f2 > param`` compiles against DATA —
+  updating the rule later is an HBM buffer swap, zero recompiles;
+* everywhere else (DeviceChain output inference at build time, host-side
+  oracles in tests) it resolves to the current host value.
+
+``version`` counts applied updates monotonically; it rides the state
+pytree as ``state["__rule_version__"]`` and the checkpoint meta, so a
+supervised restart recovers the active rules exactly-once.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+F64 = "f64"
+I64 = "i64"
+BOOL = "bool"
+
+def _to_bool(v) -> bool:
+    # control lines arrive as text: "false"/"off"/"0" must not truthy
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+_KIND_DTYPES = {F64: jnp.float64, I64: jnp.int64, BOOL: jnp.bool_}
+_KIND_COERCE = {F64: float, I64: lambda v: int(float(v)), BOOL: _to_bool}
+
+
+@dataclass(frozen=True)
+class RuleDescriptor:
+    """Declares one dynamic operator parameter: a name, its initial
+    value, and the device dtype it travels as ("f64"/"i64"/"bool")."""
+
+    name: str
+    default: Any
+    kind: str = F64
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in _KIND_DTYPES:
+            raise ValueError(
+                f"rule {self.name!r}: kind must be one of "
+                f"{sorted(_KIND_DTYPES)}, got {self.kind!r}"
+            )
+        if not self.name or not isinstance(self.name, str):
+            raise ValueError("a rule needs a non-empty string name")
+
+
+@dataclass(frozen=True)
+class RuleUpdate:
+    """One control-stream record: set ``name`` to ``value`` for every
+    data record with stream position >= ``after_records`` (0-based
+    absolute index into the source). Position-addressed updates keep the
+    schedule replay-deterministic across restarts and batch sizes."""
+
+    name: str
+    value: Any
+    after_records: int = 0
+
+
+class RuleParam:
+    """A handle to one rule value, usable directly in map/filter/CEP
+    predicates. Resolution is contextual — see the module docstring."""
+
+    __slots__ = ("_rules", "_name")
+
+    def __init__(self, rules: "RuleSet", name: str):
+        self._rules = rules
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _resolve(self):
+        leaf = self._rules._bound_leaf(self._name)
+        if leaf is not None:
+            return leaf
+        desc = self._rules.descriptor(self._name)
+        return jnp.asarray(self._rules.value(self._name), _KIND_DTYPES[desc.kind])
+
+    # jnp.asarray / tracer binary ops promote through this, so both
+    # `param > x` and `tracer > param` trace against the bound leaf
+    def __jax_array__(self):
+        return self._resolve()
+
+    def __repr__(self):
+        return f"RuleParam({self._name}={self._rules.value(self._name)!r})"
+
+    def __float__(self):
+        return float(self._rules.value(self._name))
+
+    def __int__(self):
+        return int(self._rules.value(self._name))
+
+    def __bool__(self):
+        return bool(self._rules.value(self._name))
+
+    # arithmetic / comparison dunders delegate to the resolved value
+    def __add__(self, o): return self._resolve() + o
+    def __radd__(self, o): return o + self._resolve()
+    def __sub__(self, o): return self._resolve() - o
+    def __rsub__(self, o): return o - self._resolve()
+    def __mul__(self, o): return self._resolve() * o
+    def __rmul__(self, o): return o * self._resolve()
+    def __truediv__(self, o): return self._resolve() / o
+    def __rtruediv__(self, o): return o / self._resolve()
+    def __floordiv__(self, o): return self._resolve() // o
+    def __rfloordiv__(self, o): return o // self._resolve()
+    def __mod__(self, o): return self._resolve() % o
+    def __rmod__(self, o): return o % self._resolve()
+    def __neg__(self): return -self._resolve()
+    def __abs__(self): return abs(self._resolve())
+    def __lt__(self, o): return self._resolve() < o
+    def __le__(self, o): return self._resolve() <= o
+    def __gt__(self, o): return self._resolve() > o
+    def __ge__(self, o): return self._resolve() >= o
+    def __eq__(self, o): return self._resolve() == o  # type: ignore[override]
+    def __ne__(self, o): return self._resolve() != o  # type: ignore[override]
+
+    def __hash__(self):  # pragma: no cover - params aren't dict keys
+        raise TypeError("RuleParam is not hashable")
+
+
+class RuleSet:
+    """An ordered set of dynamic rules with a monotonic version.
+
+    ``version`` is the COUNT of updates applied so far — after a restore
+    the control feed skips exactly the first ``version`` scheduled
+    updates, which is what makes crash-replay of rule application
+    idempotent (values are absolute, not increments).
+    """
+
+    def __init__(self, *descriptors: RuleDescriptor):
+        self._desc: Dict[str, RuleDescriptor] = {}
+        self._values: Dict[str, Any] = {}
+        self.version = 0
+        self._tls = threading.local()
+        for d in descriptors:
+            self._add(d)
+
+    def _add(self, d: RuleDescriptor) -> RuleParam:
+        if d.name in self._desc:
+            raise ValueError(f"rule {d.name!r} declared twice")
+        self._desc[d.name] = d
+        self._values[d.name] = _KIND_COERCE[d.kind](d.default)
+        return RuleParam(self, d.name)
+
+    def declare(self, name: str, default: Any, kind: str = F64,
+                description: str = "") -> RuleParam:
+        """Declare a rule and return its :class:`RuleParam` handle."""
+        return self._add(RuleDescriptor(name, default, kind, description))
+
+    def param(self, name: str) -> RuleParam:
+        self.descriptor(name)
+        return RuleParam(self, name)
+
+    def descriptor(self, name: str) -> RuleDescriptor:
+        try:
+            return self._desc[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown rule {name!r}; declared: {sorted(self._desc)}"
+            ) from None
+
+    def names(self) -> Tuple[str, ...]:
+        """Rule names in the canonical (sorted) state-pytree order."""
+        return tuple(sorted(self._desc))
+
+    def value(self, name: str):
+        self.descriptor(name)
+        return self._values[name]
+
+    def values(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def __len__(self) -> int:
+        return len(self._desc)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._desc
+
+    def apply(self, update: RuleUpdate) -> None:
+        """Apply one update to the host-side values and bump version."""
+        d = self.descriptor(update.name)
+        self._values[update.name] = _KIND_COERCE[d.kind](update.value)
+        self.version += 1
+
+    def reset(self) -> None:
+        """Back to the declared defaults at version 0. A from-scratch
+        restart replays the data stream from record 0, so the rule
+        timeline must replay with it — the control feed re-applies
+        every update at its original record boundary."""
+        for name, d in self._desc.items():
+            self._values[name] = _KIND_COERCE[d.kind](d.default)
+        self.version = 0
+
+    def load(self, values: Dict[str, Any], version: int) -> None:
+        """Restore host values + version from a checkpoint."""
+        for name, v in values.items():
+            if name in self._desc:
+                self._values[name] = _KIND_COERCE[self._desc[name].kind](v)
+        self.version = int(version)
+
+    def device_leaves(self) -> Dict[str, Any]:
+        """The rule pytree: {name: 0-d array} of the CURRENT values."""
+        return {
+            name: jnp.asarray(
+                self._values[name], _KIND_DTYPES[self._desc[name].kind]
+            )
+            for name in self.names()
+        }
+
+    # ---- trace-time binding -------------------------------------------
+    @contextmanager
+    def bound(self, leaves: Dict[str, Any]):
+        """Bind {name: leaf} for the duration of a step trace: every
+        RuleParam of this set resolves to its leaf inside the block."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(leaves)
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def _bound_leaf(self, name: str):
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            return stack[-1].get(name)
+        return None
+
+    def get_version(self) -> int:
+        return self.version
+
+    # Flink-flavored camelCase aliases (javacompat surface)
+    getParam = param
+    getValue = value
+    getVersion = get_version

@@ -14,6 +14,16 @@ from __future__ import annotations
 
 import datetime as _dt
 
+from .broadcast import (  # noqa: F401 — the broadcast-state surface
+    # (ruleStream.broadcast(descriptor) in Flink) re-exported with its
+    # camelCase accessors (RuleSet.getParam/getValue/getVersion,
+    # BroadcastStream.getRuleSet) so chapter-style jobs read like the
+    # original MapStateDescriptor idiom
+    BroadcastStream,
+    RuleDescriptor,
+    RuleSet,
+    RuleUpdate,
+)
 from .cep import CEP, Pattern, PatternSelectFunction  # noqa: F401 — the
 # FlinkCEP surface re-exported with its Java camelCase methods
 # (Pattern.begin(..).followedBy(..).within(..), PatternStream

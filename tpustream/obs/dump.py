@@ -183,6 +183,12 @@ def _selftest() -> int:
     # pattern operator mints through the same registry path
     g.counter("cep_matches").inc(7)
     g.counter("cep_timeouts").inc(3)
+    # dynamic-rules series (docs/dynamic_rules.md): the broadcast
+    # control stream's version gauge, update counter, and propagation
+    # latency histogram
+    g.gauge("rule_version").set(2)
+    g.counter("rule_updates_total").inc(2)
+    g.histogram("rule_update_propagation_ms").observe(1.5)
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -203,6 +209,10 @@ def _selftest() -> int:
     flight.record("config_resolved", config={"batch_size": 16})
     for i in range(6):
         flight.record("tick", i=i)
+    flight.record(
+        "rule_applied", old_version=1, new_version=2,
+        rules={"threshold": 95.0},
+    )
     flight.record_exception(ValueError("boom"), operator="window")
     dump = flight.dump(meta={"job": "selftest"})
 
@@ -265,6 +275,15 @@ def _selftest() -> int:
         ("prometheus carries the cep counters",
          'cep_matches{job="selftest"} 7' in prom
          and 'cep_timeouts{job="selftest"} 3' in prom),
+        ("render names the dynamic-rules series",
+         "rule_version" in text and "rule_updates_total" in text
+         and "rule_update_propagation_ms" in text),
+        ("prometheus carries the dynamic-rules series",
+         'rule_version{job="selftest"} 2' in prom
+         and 'rule_updates_total{job="selftest"} 2' in prom),
+        ("flight keeps the rule_applied event",
+         any(e["kind"] == "rule_applied"
+             and e.get("new_version") == 2 for e in dump["events"])),
         ("render includes health", "health: CRIT" in text),
         ("prometheus escapes the hostile label",
          'operator="he\\"llo\\\\wo\\nrld"' in prom),
@@ -273,7 +292,7 @@ def _selftest() -> int:
         ("health render works",
          "lag_crit" in render_health(snap["health"])),
         ("flight ring bounded", len(dump["events"]) == 4),
-        ("flight counts drops", dump["dropped_events"] == 4),
+        ("flight counts drops", dump["dropped_events"] == 5),
         ("flight keeps the exception",
          dump["events"][-1]["kind"] == "exception"
          and dump["events"][-1]["operator"] == "window"),

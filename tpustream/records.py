@@ -190,6 +190,21 @@ class Batch:
         valid = np.concatenate([self.valid, np.zeros(pad, dtype=np.bool_)])
         return Batch(size, cols, ts, proc, valid)
 
+    def slice_rows(self, start: int, stop: int) -> "Batch":
+        """The contiguous row range [start, stop) as its own Batch. The
+        executor splits a data batch here when a broadcast rule update
+        is positioned inside it, so update semantics are record-exact
+        and batch-size independent (docs/dynamic_rules.md)."""
+        cols = [
+            Column(c.kind, c.data[start:stop], c.table)
+            for c in self.columns
+        ]
+        ts = self.ts[start:stop] if self.ts is not None else None
+        proc = self.proc_ts[start:stop] if self.proc_ts is not None else None
+        return Batch(
+            stop - start, cols, ts, proc, self.valid[start:stop]
+        )
+
     def row(self, i: int):
         """Materialize row ``i`` as Python values (for slow/host paths)."""
         out = []

@@ -161,7 +161,10 @@ class CepProgram(BaseProgram):
             state[name] = jnp.zeros((), dtype=jnp.int64)
         state["wm"] = jnp.asarray(W0, dtype=jnp.int64)
         state["max_ts"] = jnp.asarray(W0, dtype=jnp.int64)
-        return state
+        # dynamic predicate constants (RuleParams in where() clauses)
+        # resolve against these leaves inside the traced step — a rule
+        # update swaps the buffer, never recompiles the NFA advance
+        return self._with_rules(state)
 
     # ------------------------------------------------------------------
     def _advance_round(self, sel, sk_c, sts, s_ok, s_cols, occ, start, caps):

@@ -55,7 +55,11 @@ import numpy as np
 # an uninterrupted run), and the writing supervision session's nonce;
 # snapshots are now named by source position (monotone across restart
 # attempts, where the per-attempt batch counter is not)
-FORMAT_VERSION = 8
+# v9: dynamic rules (tpustream/broadcast) — a broadcast-parameterized
+# job's state pytree carries rule leaves (__rules__/__rule_version__),
+# and meta records the host RuleSet's values plus its applied-update
+# count so a restore re-syncs the control-feed cursor exactly-once
+FORMAT_VERSION = 9
 _META_KEY = "__meta__"
 
 
@@ -130,6 +134,12 @@ class Checkpoint:
     # rollback above only applies when it matches the restoring
     # session (a pre-session snapshot predates this process's output)
     session: Optional[str] = None
+    # dynamic rules (tpustream/broadcast): the host RuleSet's values
+    # and applied-update count at snapshot time. The device rule leaves
+    # restore with the state pytree; these re-sync the HOST set so the
+    # control feed skips exactly the already-applied schedule prefix.
+    rule_values: Optional[dict] = None
+    rule_version: int = 0
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -292,6 +302,8 @@ def save_checkpoint(
     sink_counts: Optional[list] = None,
     quarantined: int = 0,
     session: Optional[str] = None,
+    rule_values: Optional[dict] = None,
+    rule_version: int = 0,
 ) -> str:
     """Snapshot to ``directory/ckpt-<source_pos>.npz`` (atomic
     write-to-.tmp + ``os.replace``); prunes to the ``keep`` newest
@@ -321,6 +333,8 @@ def save_checkpoint(
         "sink_counts": list(sink_counts) if sink_counts is not None else None,
         "quarantined": int(quarantined),
         "session": session,
+        "rule_values": rule_values,
+        "rule_version": int(rule_version),
         "checksum": _checksum(leaves),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(leaves)}
@@ -461,4 +475,6 @@ def load_checkpoint(path: str) -> Checkpoint:
         sink_counts=meta.get("sink_counts"),
         quarantined=meta.get("quarantined", 0),
         session=meta.get("session"),
+        rule_values=meta.get("rule_values"),
+        rule_version=meta.get("rule_version", 0),
     )

@@ -75,7 +75,7 @@ class CountWindowProgram(WindowProgram):
 
     def init_state(self):
         k = self.cfg.key_capacity
-        return {
+        return self._with_rules({
             # typed per-key accumulator leaves + open-window element count
             "acc": [
                 jnp.zeros((k,), dtype=self._acc_dtype(kd))
@@ -84,7 +84,7 @@ class CountWindowProgram(WindowProgram):
             "cnt": jnp.zeros((k,), dtype=jnp.int32),
             "window_fires": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
-        }
+        })
 
     # per-key [K] leaves shard on the key axis, scalars replicate — the
     # same rule the rolling per-key state uses; likewise rescale/grow
@@ -276,7 +276,7 @@ class SlidingCountWindowProgram(_ElementLogMixin, CountWindowProgram):
 
     def init_state(self):
         k, n = self.cfg.key_capacity, self.count_n
-        return {
+        return self._with_rules({
             "ebuf": [
                 jnp.zeros((k, n), dtype=self._acc_dtype(kd))
                 for kd in self.acc_kinds
@@ -284,7 +284,7 @@ class SlidingCountWindowProgram(_ElementLogMixin, CountWindowProgram):
             "tot": jnp.zeros((k,), dtype=jnp.int64),
             "window_fires": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
-        }
+        })
 
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self._apply_pre(cols, valid)
@@ -400,7 +400,7 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
         # window fires are counted host-side in evaluate_fires (the
         # process-path convention — see ProcessWindowProgram)
         k, n = self.cfg.key_capacity, self.count_n
-        return {
+        return self._with_rules({
             "ebuf": [
                 jnp.zeros((k, n), dtype=self._acc_dtype(kd))
                 for kd in self.acc_kinds
@@ -408,7 +408,7 @@ class CountProcessProgram(_ElementLogMixin, CountWindowProgram):
             "tot": jnp.zeros((k,), dtype=jnp.int64),
             "alert_overflow": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
-        }
+        })
 
     def _step(self, state, cols, valid, ts, wm_lower):
         from ..ops import panes as pane_ops

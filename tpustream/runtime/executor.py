@@ -38,7 +38,7 @@ from .sinks import (
     RetryingSink,
 )
 from .sources import SourceBatch
-from .step import LONG_MIN, build_program
+from .step import LONG_MIN, RULE_VERSION_KEY, RULES_KEY, build_program
 
 
 class HostStage:
@@ -591,6 +591,42 @@ class Runner:
             self._counter_baseline = {
                 n: int(v) for n, v in jax.device_get(present).items()
             }
+
+    def refresh_rules(self):
+        """Swap the device rule leaves to the RuleSet's CURRENT values
+        and version: two tiny H2D transfers, never a recompile — the
+        jitted step reads rules as runtime data (tpustream/broadcast).
+        On a mesh the 0-d leaves re-place replicated (P()), so every
+        shard applies version N at the same batch boundary."""
+        ruleset = getattr(self.program, "ruleset", None)
+        if (
+            ruleset is None
+            or not isinstance(self.state, dict)
+            or RULES_KEY not in self.state
+        ):
+            return
+        leaves = ruleset.device_leaves()
+        version = jnp.asarray(ruleset.version, jnp.int64)
+        mesh = getattr(self.program, "mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(mesh, P())
+
+            def _place(x):
+                if self._multiproc:
+                    a = np.asarray(x)
+                    return jax.make_array_from_callback(
+                        a.shape, sharding, lambda idx, a=a: a[idx]
+                    )
+                return jax.device_put(x, sharding)
+
+            leaves = {k: _place(v) for k, v in leaves.items()}
+            version = _place(version)
+        state = dict(self.state)
+        state[RULES_KEY] = leaves
+        state[RULE_VERSION_KEY] = version
+        self.state = state
 
     def _check_capacity(self):
         """Keyed state grows without bound, Flink's contract
@@ -1997,6 +2033,12 @@ def _execute_job(env, sink_nodes) -> JobResult:
 
         ck = load_checkpoint(restore_path)
         ck.restore_tables(plan)
+        if plan.rules is not None and ck.rule_values is not None:
+            # sync the host RuleSet to the snapshot's rule timeline
+            # BEFORE programs build: init_state seeds the rule leaves
+            # from it, and the control-feed cursor (= version) skips the
+            # already-applied schedule prefix during replay
+            plan.rules.load(ck.rule_values, ck.rule_version)
         runner = _make_runner_chain(
             plans, cfg, metrics, lazy_schemas=ck.lazy_schemas
         )
@@ -2072,6 +2114,55 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 source_pos=ck.source_pos,
             )
     lines_consumed = skip_lines
+    # -- dynamic rules (tpustream/broadcast): the control feed -------------
+    ruleset = plan.rules
+    control_feed = None
+    if plan.broadcast is not None and ruleset is not None:
+        if not restore_path:
+            # a from-scratch (re)start replays data from record 0, so
+            # the rule timeline replays with it: back to the declared
+            # defaults at version 0, and the feed re-applies every
+            # update at its original record boundary
+            ruleset.reset()
+        control_feed = plan.broadcast.feed(cfg.batch_size)
+    # perf_counter at the last rule application; the next non-empty feed
+    # closes the propagation window (bench.py phase U reads the series)
+    rule_apply_t0: List[Optional[float]] = [None]
+
+    def _apply_rule_updates(updates):
+        """Land a group of rule updates atomically at the current record
+        boundary: barrier the chain so every pre-update step retires,
+        bump the host RuleSet, and swap the device rule leaves on every
+        stage — buffer swaps, never a recompile."""
+        rule_apply_t0[0] = time.perf_counter()
+        runner.drain_chain(proc_now)
+        old_version = ruleset.version
+        for u in updates:
+            ruleset.apply(u)
+        for r in runner.chain():
+            r.refresh_rules()
+        if fault is not None:
+            # the crash window between rule application and the next
+            # data batch: recovery must re-apply the update at the same
+            # record boundary for byte-identical output
+            fault("control_apply")
+        job_obs.gauge("rule_version").set(ruleset.version)
+        job_obs.counter("rule_updates_total").inc(len(updates))
+        job_obs.flight.record(
+            "rule_applied",
+            old_version=old_version,
+            new_version=ruleset.version,
+            rules={u.name: ruleset.value(u.name) for u in updates},
+        )
+
+    def _feed_measured(b, wm_low, t0):
+        runner.feed(b, wm_low, t_batch=t0)
+        if rule_apply_t0[0] is not None and b.n:
+            job_obs.histogram("rule_update_propagation_ms").observe(
+                (time.perf_counter() - rule_apply_t0[0]) * 1000.0
+            )
+            rule_apply_t0[0] = None
+
     ckpt_every = cfg.checkpoint_interval_batches
     ckpt_enabled = bool(cfg.checkpoint_dir) and ckpt_every > 0
     # Emission pipelining helps only when batches arrive back to back; a
@@ -2300,7 +2391,31 @@ def _execute_job(env, sink_nodes) -> JobResult:
             if marker_backlog:
                 runner.accept_markers(marker_backlog)
                 marker_backlog = []
-            runner.feed(batch, wm_lower_for_records(wm_hint), t_batch=hw.t0)
+            wm_low = wm_lower_for_records(wm_hint)
+            if control_feed is None:
+                runner.feed(batch, wm_low, t_batch=hw.t0)
+            else:
+                # split the batch at each pending update's record
+                # boundary: rows before position N run under the old
+                # rules, rows at/after N under the new — record-exact
+                # and batch-size independent (docs/dynamic_rules.md)
+                base = lines_consumed - sb.n_records
+                cursor = 0
+                for off, updates in control_feed.splits_for(
+                    base, sb.n_records
+                ):
+                    # quarantined rows can shrink the parsed batch
+                    # below the source count; clamp to real rows
+                    off = min(off, batch.n)
+                    if off > cursor:
+                        _feed_measured(
+                            batch.slice_rows(cursor, off), wm_low, hw.t0
+                        )
+                        cursor = off
+                    _apply_rule_updates(updates)
+                rest = batch.slice_rows(cursor, batch.n) if cursor else batch
+                if rest.n or not cursor:
+                    _feed_measured(rest, wm_low, hw.t0)
             if idle:
                 runner.drain_inflight()
         elif (
@@ -2393,6 +2508,15 @@ def _execute_job(env, sink_nodes) -> JobResult:
                     session=(
                         supervision.nonce if supervision is not None else None
                     ),
+                    # dynamic rules: the host RuleSet's values + applied-
+                    # update count at the snapshot — restore re-syncs the
+                    # control-feed cursor from these (broadcast/rules.py)
+                    rule_values=(
+                        ruleset.values() if ruleset is not None else None
+                    ),
+                    rule_version=(
+                        ruleset.version if ruleset is not None else 0
+                    ),
                 )
             # snapshot cost series (docs/observability.md)
             job_obs.histogram("checkpoint_save_ms").observe(
@@ -2424,6 +2548,12 @@ def _execute_job(env, sink_nodes) -> JobResult:
             # final markers ride the end-of-stream flush step
             runner.accept_markers(marker_backlog)
             marker_backlog = []
+        if control_feed is not None:
+            # updates positioned at/after the last record still apply —
+            # they govern the EOS window fires deterministically
+            eos_updates = control_feed.remaining(lines_consumed)
+            if eos_updates:
+                _apply_rule_updates(eos_updates)
         if domain == TimeCharacteristic.ProcessingTime:
             runner.flush(proc_now - 1)
         else:

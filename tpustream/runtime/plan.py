@@ -230,6 +230,12 @@ class JobPlan:
     # emissions all see the visible record without it.
     synthetic_key: bool = False
     derived_key_fn: Optional[Any] = None
+    # dynamic rules (tpustream/broadcast): every stage of a job shares
+    # ONE RuleSet object, so a control-stream update reaches the whole
+    # chain at the same record boundary. None = no dynamic parameters;
+    # the state pytree then carries no rule leaves (treedef unchanged).
+    rules: Optional[Any] = None
+    broadcast: Optional[Any] = None      # the BroadcastStream, stage 0 only
 
 
 def _is_raw_stage(kinds: Optional[List[str]]) -> bool:
@@ -237,6 +243,12 @@ def _is_raw_stage(kinds: Optional[List[str]]) -> bool:
 
 
 def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
+    # dynamic-rules control stream, registered by DataStream.broadcast().
+    # Its source node is NOT part of the sink walk below — control
+    # records never enter the data path; the executor drains them into
+    # rule-pytree updates between data batches.
+    broadcast = getattr(env, "_broadcast", None)
+
     # separate main sinks from side-output sinks
     main_sinks: List[Node] = []
     side_sinks: List[Node] = []
@@ -496,6 +508,8 @@ def build_plan(env, sink_nodes: List[Node]) -> JobPlan:
         chain_rest=chain_rest,
         synthetic_key=synthetic_key,
         derived_key_fn=derived_key_fn,
+        rules=getattr(broadcast, "rules", None),
+        broadcast=broadcast,
     )
 
 
@@ -678,4 +692,7 @@ def _plan_rest(env, rest: List[Node]) -> JobPlan:
         upstream_supplies_ts=True,
         synthetic_key=synthetic_key,
         derived_key_fn=derived_key_fn,
+        # chained stages share stage 0's RuleSet: one control stream
+        # parameterizes the whole chain at the same record boundary
+        rules=getattr(getattr(env, "_broadcast", None), "rules", None),
     )

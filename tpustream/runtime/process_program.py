@@ -91,7 +91,7 @@ class ProcessWindowProgram(WindowProgram):
         k, n = self.cfg.key_capacity, self.ring.n_slots
         cap = self.cfg.process_buffer_capacity
         hi0 = jnp.asarray(-1, dtype=jnp.int64)
-        return {
+        return self._with_rules({
             "buf": [
                 jnp.zeros((k, n, cap), dtype=self._acc_dtype(kd))
                 for kd in self.acc_kinds
@@ -105,7 +105,7 @@ class ProcessWindowProgram(WindowProgram):
             "buffer_overflow": jnp.zeros((), dtype=jnp.int64),
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
             "late_dropped": jnp.zeros((), dtype=jnp.int64),
-        }
+        })
 
     def state_specs(self, state):
         # the base ndim>=2 rule is exactly right here: buf [K,N,cap] and

@@ -188,7 +188,7 @@ class SessionWindowProgram(WindowProgram):
         # not the time-window word-plane fast path)
         k, n = self.cfg.key_capacity, self.ring.n_slots
         hi0 = jnp.asarray(-1, dtype=jnp.int64)
-        return {
+        return self._with_rules({
             # identity-initialized (not zero): the scatter-reduce fast
             # path merges straight into unoccupied cells
             "acc": [
@@ -219,7 +219,7 @@ class SessionWindowProgram(WindowProgram):
             "cell_fired": jnp.zeros((k, n), dtype=bool),
             "window_fires": jnp.zeros((), dtype=jnp.int64),
             "late_dropped": jnp.zeros((), dtype=jnp.int64),
-        }
+        })
 
     def state_specs(self, state):
         # typed [K, N] cells shard on the KEY axis (axis 0), unlike the

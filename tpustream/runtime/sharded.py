@@ -101,8 +101,12 @@ class _ShardedMixin:
         )
         # all emission leaves carry per-shard rows
         out_specs = (state_specs, P(AXIS))
+        # traced_step(): the dynamic-rules wrapper when the plan declares
+        # a RuleSet (rule leaves are 0-d -> P() above -> replicated, so
+        # every shard evaluates the same rule version per batch), else
+        # _step itself
         fn = _shard_map(
-            self._step,
+            self.traced_step(),
             mesh=self.mesh,
             in_specs=in_specs,
             out_specs=out_specs,

@@ -24,6 +24,11 @@ from .plan import JobPlan
 
 LONG_MIN = -(2**63)
 
+# dynamic-rule leaves ride the state pytree under these keys (only when
+# the plan declares a RuleSet — rule-less jobs keep their exact treedef)
+RULES_KEY = "__rules__"
+RULE_VERSION_KEY = "__rule_version__"
+
 
 def _np_dtype(kind: str):
     return NUMPY_DTYPES[kind]
@@ -49,6 +54,12 @@ class BaseProgram:
         in_kinds, in_tables = plan.record_kinds, plan.tables
         if plan.synthetic_key and in_kinds:
             in_kinds, in_tables = in_kinds[:-1], in_tables[:-1]
+        # dynamic rules (tpustream/broadcast): RuleParams in user fns
+        # resolve to host values here at chain-build time (DeviceChain's
+        # concrete output dry-run) and to the traced state leaves inside
+        # _rules_step — one mechanism covers pre/post chains and CEP
+        # predicates without any per-program plumbing
+        self.ruleset = plan.rules
         self.pre_chain = DeviceChain(plan.device_pre, in_kinds, in_tables)
         self.mid_kinds = self.pre_chain.out_kinds
         self.mid_tables = self.pre_chain.out_tables
@@ -83,8 +94,43 @@ class BaseProgram:
 
     # subclasses: init_state(), _step(state, cols, valid, ts, wm_lower)
 
+    def _with_rules(self, state: dict) -> dict:
+        """Attach the rule pytree to a family's init state: one 0-d
+        leaf per rule plus the applied-update counter. Replicated on
+        the mesh (P() specs), so every shard applies version N at the
+        same batch boundary."""
+        if self.ruleset is None:
+            return state
+        state = dict(state)
+        state[RULES_KEY] = self.ruleset.device_leaves()
+        state[RULE_VERSION_KEY] = jnp.asarray(self.ruleset.version, jnp.int64)
+        return state
+
+    def _rules_step(self, state, cols, valid, ts, wm_lower):
+        """The traced wrapper when rules are declared: strip the rule
+        leaves, bind them for the duration of the _step trace (every
+        RuleParam then resolves to its leaf — parameters compile as
+        DATA), and pass them through unchanged. Updates happen host-side
+        between steps as plain buffer swaps on ``state[RULES_KEY]``, so
+        the compiled program never changes."""
+        rules = state[RULES_KEY]
+        inner = {
+            k: v for k, v in state.items()
+            if k not in (RULES_KEY, RULE_VERSION_KEY)
+        }
+        with self.ruleset.bound(rules):
+            new_state, emissions = self._step(inner, cols, valid, ts, wm_lower)
+        new_state = dict(new_state)
+        new_state[RULES_KEY] = rules
+        new_state[RULE_VERSION_KEY] = state[RULE_VERSION_KEY]
+        return new_state, emissions
+
+    def traced_step(self):
+        """What jit (and the sharded mixin's shard_map) compile."""
+        return self._step if self.ruleset is None else self._rules_step
+
     def jitted_step(self):
-        return jax.jit(self._step, donate_argnums=0)
+        return jax.jit(self.traced_step(), donate_argnums=0)
 
     def state_specs(self, state):
         """Mesh sharding specs for the state pytree (default: arrays with
@@ -270,7 +316,9 @@ class StatelessProgram(BaseProgram):
         self.emit_capacity = max(cfg.alert_capacity, cfg.batch_size)
 
     def init_state(self):
-        return {"alert_overflow": jnp.zeros((), dtype=jnp.int64)}
+        return self._with_rules(
+            {"alert_overflow": jnp.zeros((), dtype=jnp.int64)}
+        )
 
     def _step(self, state, cols, valid, ts, wm_lower):
         from ..ops import panes as pane_ops
@@ -344,9 +392,11 @@ class RollingProgram(BaseProgram):
         return None
 
     def init_state(self):
-        return rolling_ops.init_rolling_state(
-            self.cfg.key_capacity, self.mid_kinds, self._compact32,
-            sentinel_leaf=self._sentinel_leaf,
+        return self._with_rules(
+            rolling_ops.init_rolling_state(
+                self.cfg.key_capacity, self.mid_kinds, self._compact32,
+                sentinel_leaf=self._sentinel_leaf,
+            )
         )
 
     def state_specs(self, state):

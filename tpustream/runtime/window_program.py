@@ -384,7 +384,7 @@ class WindowProgram(BaseProgram):
         n, kk = self.ring.n_slots, self.cfg.key_capacity
         hi0 = jnp.asarray(-1, dtype=jnp.int64)
         idents = self._plane_identities()
-        return {
+        return self._with_rules({
             "planes": [
                 jnp.full((n * kk,), ident, dtype=dt)
                 for dt, ident in zip(self.plane_dtypes, idents)
@@ -401,7 +401,7 @@ class WindowProgram(BaseProgram):
             "exchange_overflow": jnp.zeros((), dtype=jnp.int64),
             "window_fires": jnp.zeros((), dtype=jnp.int64),
             "late_dropped": jnp.zeros((), dtype=jnp.int64),
-        }
+        })
 
     # ------------------------------------------------------------------
     # legacy typed-cell scatter — kept for SessionWindowProgram, which

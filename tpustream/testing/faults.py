@@ -1,6 +1,6 @@
 """Seeded fault injection for supervised-execution tests and bench.
 
-The runtime exposes five control-plane fault points, checked on the
+The runtime exposes seven control-plane fault points, checked on the
 paths named after them:
 
 * ``source_read``  — before each source batch enters the host stage
@@ -16,6 +16,12 @@ paths named after them:
   all_to_all
 * ``sink_emit``    — inside each sink emit attempt (so sink retry
   with backoff is exercised; see runtime/sinks.py RetryingSink)
+* ``control_apply``— after a broadcast rule update lands on the
+  device rule pytree, before the next data batch dispatches: targets
+  the crash window between rule application and the batch it governs
+  (the recovered run must re-apply the update at the same record
+  boundary — byte-identical output; see tpustream/broadcast and
+  docs/dynamic_rules.md)
 
 An injector installs into ``StreamConfig.extra["fault_injector"]`` (use
 :meth:`FaultInjector.install`); the executor reads it from there so the
@@ -42,6 +48,7 @@ FAULT_POINTS = (
     "cep_step",
     "exchange",
     "sink_emit",
+    "control_apply",
 )
 
 
