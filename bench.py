@@ -1049,7 +1049,8 @@ def device_cep(stream_hash, B_p=1 << 17, key_counts=(1 << 14, 1 << 17),
     return dict(batch=B_p, within_ms=WITHIN_MS, sweep=sweep)
 
 
-def decompose_full_path(n_batches=10):
+def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
+                        pipelined=True):
     """Stage-attributed account of the full execute_job path (VERDICT r3
     next #4): run the flagship shape batch by batch SYNCHRONOUSLY and
     time each stage — host parse+intern, delta-pack, H2D+device step
@@ -1057,7 +1058,12 @@ def decompose_full_path(n_batches=10):
     RTT. Under pipelining (async_depth) stages overlap, so the achieved
     full-path rate is set by the BINDING stage, not the sum; this phase
     names that stage with measured numbers instead of attributing the
-    shortfall to 'the tunnel' wholesale."""
+    shortfall to 'the tunnel' wholesale. A second pass runs the SAME
+    shape through the async executor (staged H2D uploads, device-side
+    compaction, deep dispatch queue) so the sync-vs-pipelined ms/batch
+    ratio is the measured overlap win. ``bl``/``nkey``/``n_batches``
+    are parameters so a tier-1 tiny-mode smoke can exercise the exact
+    phase logic without flagship-sized buffers."""
     import jax
 
     from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
@@ -1067,31 +1073,44 @@ def decompose_full_path(n_batches=10):
     from tpustream.runtime.metrics import Metrics
     from tpustream.runtime.plan import build_plan_chain
 
-    BL, NKEY = 1 << 16, 1 << 20
-    tpl, tcols = _render_flagship_lines(BL, NKEY)
+    def make_runner(cfg):
+        env = StreamExecutionEnvironment(cfg)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        sink = []
+        build(
+            env, env.add_source(None), size=Time.seconds(5),
+            slide=Time.seconds(1),
+        ).add_sink(lambda r: sink.append(r))
+        plan = build_plan_chain(env, env._sinks)[0]
+        return HostStage(plan, cfg), Runner(plan, cfg, Metrics())
+
+    def parse_batch(host, sb):
+        """Native raw-bytes lane, falling back to the line path where
+        the native parser isn't built (the tier-1 CPU smoke env) — the
+        stage decomposition then times the Python parse instead."""
+        batch, _ = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
+        if batch is None:
+            lines = bytes(sb.raw).decode().splitlines()[: sb.n_raw]
+            batch, _ = host.process(lines, sb.proc_ts)
+        return batch
+
+    tpl, tcols = _render_flagship_lines(bl, nkey)
     cfg = StreamConfig(
-        batch_size=BL, key_capacity=NKEY, alert_capacity=1 << 16,
+        batch_size=bl, key_capacity=nkey, alert_capacity=1 << 16,
         async_depth=1, max_batch_delay_ms=0.0,
     )
-    env = StreamExecutionEnvironment(cfg)
-    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
-    sink = []
-    build(
-        env, env.add_source(None), size=Time.seconds(5), slide=Time.seconds(1)
-    ).add_sink(lambda r: sink.append(r))
-    plan = build_plan_chain(env, env._sinks)[0]
-    host = HostStage(plan, cfg)
-    runner = Runner(plan, cfg, Metrics())
+    host, runner = make_runner(cfg)
 
-    src = _GenBytesSource(tpl, tcols, n_batches + 3, 0, BL, 1_566_957_600_000)
+    src = _GenBytesSource(tpl, tcols, n_batches + 3, 0, bl, 1_566_957_600_000)
     t_parse, t_pack, t_feed, t_rtt = [], [], [], []
     wm_lower = -(2 ** 62)
+    raw_bytes = wire_bytes = 0
     b = 0
-    for sb in src.batches(BL, 0.0):
+    for sb in src.batches(bl, 0.0):
         if sb.final:
             break
         t0 = time.perf_counter()
-        batch, _ = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
+        batch = parse_batch(host, sb)
         t1 = time.perf_counter()
         # pack timed on its own (feed() re-packs internally; the pack is
         # pure numpy and cheap to run twice)
@@ -1099,6 +1118,14 @@ def decompose_full_path(n_batches=10):
             [np.asarray(c.data) for c in batch.columns],
             np.asarray(batch.valid),
             np.asarray(batch.ts),
+        )
+        # bytes/row before and after the packed wire format (satellite:
+        # the wire-ceiling math needs the POST-pack number; the delta is
+        # what the narrow format saves)
+        raw_bytes = (
+            sum(int(np.asarray(c.data).nbytes) for c in batch.columns)
+            + int(np.asarray(batch.valid).nbytes)
+            + int(np.asarray(batch.ts).nbytes)
         )
         wire_bytes = (
             sum(int(np.asarray(a).nbytes) for a in packed)
@@ -1132,57 +1159,104 @@ def decompose_full_path(n_batches=10):
         "count_fetch_rtt_ms": rtt_ms,
         "batch_total_sync_ms": parse_ms + feed_ms,
     }
-    sync_rate = BL / ((parse_ms + feed_ms) / 1e3)
+    sync_rate = bl / ((parse_ms + feed_ms) / 1e3)
     binding = max(
         ("parse_intern_ms", parse_ms),
         ("h2d_step_fetch_ms", feed_ms - pack_ms),
         key=lambda kv: kv[1],
     )
+
+    # pipelined pass: default config (async_depth, h2d_depth staging,
+    # compaction) over the same batches; ms/batch here is the overlapped
+    # steady-state cost the flood actually pays
+    pipelined_ms = pipelined_rate = None
+    if pipelined:
+        cfg2 = StreamConfig(
+            batch_size=bl, key_capacity=nkey, alert_capacity=1 << 16,
+            max_batch_delay_ms=0.0,
+        )
+        host2, runner2 = make_runner(cfg2)
+        src2 = _GenBytesSource(
+            tpl, tcols, n_batches + 3, 0, bl, 1_566_957_600_000
+        )
+        b2 = 0
+        t_start = None
+        for sb in src2.batches(bl, 0.0):
+            if sb.final:
+                break
+            batch = parse_batch(host2, sb)
+            if b2 == 3:  # warm batches compiled + drained; clock starts
+                runner2.drain_inflight()
+                t_start = time.perf_counter()
+            runner2.feed(batch, wm_lower)
+            b2 += 1
+        runner2.drain_inflight()
+        if t_start is not None and b2 > 3:
+            pipelined_ms = (time.perf_counter() - t_start) / (b2 - 3) * 1e3
+            pipelined_rate = bl / (pipelined_ms / 1e3)
+
     return dict(
-        rows_per_batch=BL,
-        wire_bytes_per_row=wire_bytes / BL,
+        rows_per_batch=bl,
+        wire_bytes_per_row=wire_bytes / bl,
+        bytes_per_row_raw=raw_bytes / bl,
+        bytes_per_row_packed=wire_bytes / bl,
         stages_ms=stages,
         sync_rows_per_s=sync_rate,
         binding_stage=binding[0],
         binding_ms=binding[1],
+        pipelined_ms_per_batch=pipelined_ms,
+        pipelined_rows_per_s=pipelined_rate,
     )
 
 
 def measure_h2d():
-    """The tunnel/PCIe H2D bandwidth actually available to batches:
-    PIPELINED batch-sized transfers (the executor's pattern — many
-    ~1 MB puts in flight, consumed on device, one scalar fetched at the
-    end; block_until_ready lies through the tunnel). A serial
-    few-big-chunks probe under-reads the link by a per-put round trip."""
+    """The tunnel/PCIe H2D bandwidth actually available to batches.
+
+    BENCH_r05 recorded 9 MB/s here, contradicting the decomposition's
+    own transfer numbers — bogus: the old probe issued 12 SEQUENTIAL
+    1 MB ``device_put`` calls, and through a tunnel each put pays the
+    full link round trip before the next dispatches, so it measured
+    12x RTT, not the wire. Two fixes: (1) each pass ships ONE batched
+    ``jax.device_put`` of all chunks so the runtime streams them
+    back-to-back, and (2) the bare fetch RTT of the closing scalar —
+    measured separately against an already-resident array — is
+    subtracted from the elapsed wall so the reported rate is transfer
+    time, not round-trip residency."""
     import jax
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
-    one_mb = 1 << 20
+    chunk = 4 << 20
+    n_chunks = 8
     rng = np.random.default_rng(0)
     arrs = [
-        rng.integers(0, 127, one_mb, dtype=np.int8) for _ in range(12)
+        rng.integers(0, 127, chunk, dtype=np.int8) for _ in range(n_chunks)
     ]
-    consume = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
-    _ = np.asarray(consume(jax.device_put(arrs[0], dev)))
+    consume = jax.jit(
+        lambda xs: sum(jnp.sum(x, dtype=jnp.int32) for x in xs)
+    )
+    _ = np.asarray(consume(jax.device_put(arrs, dev)))  # compile + warm
+    # bare link RTT: fetch of an already-device-resident scalar
+    resident = consume(jax.device_put(arrs, dev))
+    _ = np.asarray(resident)
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _ = np.asarray(resident)
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
-        accs = [consume(jax.device_put(a, dev)) for a in arrs]
-        tot = accs[0]
-        for a in accs[1:]:
-            tot = tot + a
+        tot = consume(jax.device_put(arrs, dev))
         _ = np.asarray(tot)
-        rates.append(
-            len(arrs) * one_mb / (time.perf_counter() - t0) / 1e6
-        )
-    # median-of-3 = the SUSTAINED rate a flood can actually ride;
-    # the burst max is logged for context but overstates capacity
+        el = max(1e-9, time.perf_counter() - t0 - rtt)
+        rates.append(n_chunks * chunk / el / 1e6)
     rates.sort()
     log(
-        f"phase H detail: pipelined H2D passes "
-        f"{', '.join(f'{r:.0f}' for r in rates)} MB/s "
-        f"(median reported; burst max {rates[-1]:.0f})"
+        f"phase H detail: batched H2D passes "
+        f"{', '.join(f'{r:.0f}' for r in rates)} MB/s after subtracting "
+        f"the {rtt*1e3:.1f} ms closing-fetch RTT (median reported)"
     )
     return rates[1]
 
@@ -1584,7 +1658,8 @@ def main():
         s = decomp["stages_ms"]
         log(
             f"phase J: full-path decomposition (per {decomp['rows_per_batch']}"
-            f"-row batch, {decomp['wire_bytes_per_row']:.1f} wire B/row): "
+            f"-row batch, {decomp['bytes_per_row_raw']:.1f} raw -> "
+            f"{decomp['bytes_per_row_packed']:.1f} packed wire B/row): "
             f"parse+intern {s['parse_intern_ms']:.1f} ms, pack "
             f"{s['pack_ms']:.1f} ms, H2D+step+fetch "
             f"{s['h2d_step_fetch_ms']:.1f} ms (bare RTT "
@@ -1594,6 +1669,14 @@ def main():
             f"binding stage: {decomp['binding_stage']} "
             f"({decomp['binding_ms']:.1f} ms)"
         )
+        if decomp.get("pipelined_ms_per_batch"):
+            log(
+                f"phase J: pipelined pass (staged H2D + compaction + "
+                f"async dispatch): {decomp['pipelined_ms_per_batch']:.1f} "
+                f"ms/batch -> {decomp['pipelined_rows_per_s']/1e6:.2f}M "
+                f"rows/s, {s['batch_total_sync_ms'] / max(1e-9, decomp['pipelined_ms_per_batch']):.1f}x "
+                f"over sync"
+            )
         if h2d_mb_s:
             wire_ceiling = (
                 h2d_mb_s * 1e6 / decomp["wire_bytes_per_row"]

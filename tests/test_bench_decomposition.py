@@ -1,0 +1,43 @@
+"""Tier-1 bench smoke: the full-path decomposition and H2D-bandwidth
+phases run in tiny mode on CPU, so stage-timing regressions (a stage
+key disappearing, the pipelined pass deadlocking, the bandwidth probe
+reverting to its RTT-corrupted form) are caught without the full bench.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_decompose_full_path_tiny_mode(bench):
+    d = bench.decompose_full_path(n_batches=2, bl=256, nkey=1024)
+    s = d["stages_ms"]
+    for key in (
+        "parse_intern_ms", "pack_ms", "h2d_step_fetch_ms",
+        "count_fetch_rtt_ms", "batch_total_sync_ms",
+    ):
+        assert key in s and s[key] >= 0, key
+    assert d["rows_per_batch"] == 256
+    assert d["sync_rows_per_s"] > 0
+    assert d["binding_stage"] in ("parse_intern_ms", "h2d_step_fetch_ms")
+    # the packed wire format must only ever shrink a row
+    assert 0 < d["bytes_per_row_packed"] <= d["bytes_per_row_raw"]
+    assert d["wire_bytes_per_row"] == d["bytes_per_row_packed"]
+    # the pipelined pass ran and drained (deadlock here = no number)
+    assert d["pipelined_ms_per_batch"] > 0
+    assert d["pipelined_rows_per_s"] > 0
+
+
+def test_measure_h2d_reports_positive_bandwidth(bench):
+    mb_s = bench.measure_h2d()
+    assert mb_s > 0

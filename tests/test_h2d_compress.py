@@ -1,8 +1,11 @@
-"""H2D delta compression: lossless int64 packing and its demotion path.
+"""H2D wire packing: lossless narrow formats and their demotion paths.
 
-The executor ships int64 columns/timestamps as int32 deltas against a
-per-batch base (StreamConfig.h2d_compress); a batch whose valid-row span
-exceeds int32 must demote that column to raw PERMANENTLY — rebuilding
+The executor ships int64 columns/timestamps as deltas against a
+per-batch base (StreamConfig.h2d_compress) — uint16 deltas first under
+the packed wire format (StreamConfig.packed_wire), int32 past a 2^16
+span — and narrows float64 to exact-round-trip float32 and interned
+string ids to int16. A batch whose valid rows no longer fit the narrow
+form must demote that column down its chain PERMANENTLY — rebuilding
 the jitted step mid-stream — with bit-exact results either way.
 """
 
@@ -18,11 +21,11 @@ def parse(line: str) -> Tuple2:
     return Tuple2(items[1], int(items[2]))
 
 
-def run(lines, **cfg):
-    env = StreamExecutionEnvironment(StreamConfig(batch_size=4, **cfg))
+def run(lines, batch_size=4, parse_fn=parse, **cfg):
+    env = StreamExecutionEnvironment(StreamConfig(batch_size=batch_size, **cfg))
     text = env.add_source(ReplaySource(lines))
     handle = (
-        text.map(parse)
+        text.map(parse_fn)
         .key_by(0)
         .sum(1)
         .collect()
@@ -52,6 +55,58 @@ def test_mid_stream_span_overflow_demotes_exactly():
         totals[k] = totals.get(k, 0) + int(v)
         expect.append((k, totals[k]))
     assert got == expect
+
+
+def test_d16_span_overflow_demotes_to_d32_exactly():
+    """Batch 1 fits uint16 deltas; batch 2 spans past 2^16 (but inside
+    int32) so the column demotes d16 -> d32, one recompile; batch 3's
+    small values ride the demoted d32 form — identical output to both
+    unpacked configs."""
+    lines = (
+        ["1 a 5", "1 b 7", "1 a 11", "1 b 13"]
+        + ["1 a 100000", "1 b 3", "1 a 200000", "1 b 4"]
+        + ["1 a 23", "1 b 29", "1 a 31", "1 b 37"]
+    )
+    got = run(lines)
+    assert got == run(lines, packed_wire=False)
+    assert got == run(lines, h2d_compress=False, packed_wire=False)
+
+
+def parse_float(line: str) -> Tuple2:
+    items = line.split(" ")
+    return Tuple2(items[1], float(items[2]))
+
+
+def test_f32_inexact_value_demotes_exactly():
+    """2^24 + 1 rounds in float32 (16777217 -> 16777216): the exact
+    round-trip check must demote the float column to raw float64 for
+    that batch and after — sums stay bit-exact."""
+    lines = (
+        ["1 a 1.5", "1 b 2.5", "1 a 0.25", "1 b 4.0"]  # all exact in f32
+        + ["1 a 16777217.0", "1 b 0.5", "1 a 1.0", "1 b 2.0"]
+        + ["1 a 0.125", "1 b 8.0", "1 a 16.0", "1 b 32.0"]
+    )
+    got = run(lines, parse_fn=parse_float)
+    want = run(lines, parse_fn=parse_float, packed_wire=False)
+    assert got == want
+    totals = {}
+    for line in lines:
+        _, k, v = line.split(" ")
+        totals[k] = totals.get(k, 0.0) + float(v)
+    assert got[-1] == ("b", totals["b"]) and got[-2] == ("a", totals["a"])
+
+
+def test_i16_id_overflow_demotes_exactly():
+    """More than 2^15 distinct interned strings push key ids past
+    int16: the id column demotes i16 -> raw int32 mid-stream and every
+    key's sum survives. (Batch 4096 keeps this a ~9-step run.)"""
+    n = (1 << 15) + 4096  # crosses 32767 in the final batches
+    lines = [f"1 k{i} {i % 13}" for i in range(n)]
+    cfg = dict(batch_size=4096, key_capacity=1 << 16, alert_capacity=4096)
+    got = run(lines, **cfg)
+    want = run(lines, packed_wire=False, **cfg)
+    assert got == want
+    assert got[-1] == (f"k{n - 1}", (n - 1) % 13)
 
 
 def test_full_range_column_never_compresses():

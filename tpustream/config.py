@@ -234,6 +234,47 @@ class StreamConfig:
     # traffic on the host link. A column whose per-batch span exceeds
     # int32 falls back to raw permanently (one recompile).
 
+    packed_wire: bool = True
+    # Narrow packed wire format on top of h2d_compress: each H2D column
+    # ships in the narrowest dtype the batch's values admit and widens
+    # back on device. int64 deltas start at uint16 (d16) before falling
+    # back to the int32 deltas above; float64 columns ship as float32
+    # while every valid value round-trips exactly; interned-string id
+    # columns ship as int16 while ids fit; bool columns and the valid
+    # mask ship bit-packed (8 rows/byte). Demotions are sticky and
+    # per-column (at most one recompile each, same policy as
+    # h2d_compress), so outputs stay byte-identical to packed_wire=False.
+    # Multi-host runs keep row-width packing but skip bit-packing (the
+    # per-process shard split assumes one row per wire element).
+
+    h2d_depth: int = 2
+    # Upload-side pipeline depth: how many packed batches may be staged
+    # on the device ahead of the step that consumes them. 1 = the
+    # classic path (the transfer rides the step call). 2 (default) =
+    # double-buffered H2D: batch N+1's device_put is issued before batch
+    # N's step group fetch blocks, so its transfer crosses the wire
+    # while the host waits on N's emission counts. Staged batches are
+    # flushed at every pipeline barrier (checkpoint, rule update, key
+    # growth, paced-source idle, end of stream), so checkpoint/recovery
+    # semantics and output bytes are unchanged — only wall-clock overlap
+    # shifts. Forced to 1 under multi-host, for programs whose
+    # emissions reference live state, and when max_fires_per_step
+    # paces the step loop.
+
+    compaction_capacity: int = 4096
+    # Device-side output compaction: each mask-carrying emission stream
+    # gets a compiled compaction stage that gathers its (sparse) emitted
+    # rows into a fixed [compaction_capacity] buffer in emission order,
+    # so fetch pulls count + compacted rows instead of full [batch_size]
+    # outputs. A step whose per-stream count exceeds the capacity spills
+    # to the classic full fetch (flight breadcrumb + compaction_spills
+    # counter) — semantics are exact at any alert density, the capacity
+    # only tunes wire bytes. 0 disables the compaction stage entirely.
+    # Single-chip only: on a multi-device mesh the compact gather
+    # inserts a per-step all-gather whose rendezvous cost dwarfs the
+    # fetch saving, and multi-host fetch needs the per-process dense
+    # buffers for the chain merge — both keep the full path.
+
     # -- observability ------------------------------------------------------
     obs: ObsConfig = field(default_factory=ObsConfig)
 

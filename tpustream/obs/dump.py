@@ -189,6 +189,14 @@ def _selftest() -> int:
     g.gauge("rule_version").set(2)
     g.counter("rule_updates_total").inc(2)
     g.histogram("rule_update_propagation_ms").observe(1.5)
+    # async-pipeline series (docs/performance.md): wire-byte counters,
+    # the compaction win, spills, and the lazily-evaluated occupancy
+    # gauge the executor registers with set_fn
+    g.counter("h2d_bytes_total").inc(1_048_576)
+    g.counter("fetch_bytes_total").inc(4096)
+    g.counter("compaction_spills").inc(1)
+    g.gauge("compaction_ratio").set(0.015625)
+    g.gauge("pipeline_occupancy").set_fn(lambda: 3)
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -281,6 +289,16 @@ def _selftest() -> int:
         ("prometheus carries the dynamic-rules series",
          'rule_version{job="selftest"} 2' in prom
          and 'rule_updates_total{job="selftest"} 2' in prom),
+        ("render names the pipeline wire counters",
+         "h2d_bytes_total" in text and "fetch_bytes_total" in text),
+        ("prometheus carries the pipeline wire counters",
+         'h2d_bytes_total{job="selftest"} 1048576' in prom
+         and 'fetch_bytes_total{job="selftest"} 4096' in prom),
+        ("prometheus carries the compaction series",
+         'compaction_spills{job="selftest"} 1' in prom
+         and 'compaction_ratio{job="selftest"} 0.015625' in prom),
+        ("set_fn occupancy gauge evaluates in the exposition",
+         'pipeline_occupancy{job="selftest"} 3' in prom),
         ("flight keeps the rule_applied event",
          any(e["kind"] == "rule_applied"
              and e.get("new_version") == 2 for e in dump["events"])),

@@ -1,12 +1,15 @@
 """Structured step tracing: lightweight span events per executor step.
 
-Each hot-path phase (``parse``, ``pack``, ``dispatch``, ``fetch``,
-``emit``) records one span per *batch/step* — never per record — into a
-bounded ring buffer. ``dispatch`` covers the jitted-step enqueue
-including the H2D transfer of the packed batch (this runtime has no
-separate ``device_put``; the transfer rides the step call), so there is
-no distinct H2D span on the host side — enable the ``jax.profiler``
-bridge to see the device-side split.
+Each hot-path phase (``parse``, ``pack``, ``h2d``, ``dispatch``,
+``fetch``, ``emit``) records one span per *batch/step* — never per
+record — into a bounded ring buffer. With double-buffered uploads
+(``StreamConfig.h2d_depth`` > 1) the ``h2d`` span times the async
+``device_put`` issue for a staged batch — overlap shows up as ``h2d``
+spans of step N+1 landing before the ``fetch`` span of step N closes.
+At ``h2d_depth`` 1 there is no separate ``device_put`` (the transfer
+rides the step call inside ``dispatch``) and no ``h2d`` spans are
+recorded — enable the ``jax.profiler`` bridge to see the device-side
+split in that mode.
 
 The bridge wraps each span in ``jax.profiler.TraceAnnotation`` so a
 ``jax.profiler.trace(...)`` capture shows host spans aligned with XLA
@@ -22,7 +25,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-SPAN_KINDS = ("parse", "pack", "dispatch", "fetch", "emit")
+SPAN_KINDS = ("parse", "pack", "h2d", "dispatch", "fetch", "emit")
 
 
 class _Span:

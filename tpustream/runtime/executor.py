@@ -428,6 +428,7 @@ class Runner:
         # exceeds int32 is demoted to raw permanently (one recompile).
         self._col_modes: Optional[tuple] = None
         self._ts_mode: Optional[str] = None
+        self._valid_mode: Optional[str] = None
         self.step = None  # built on the first batch, when modes are known
         self.state = self.program.init_state()
         self.sinks, self.side_sinks = _make_sinks(plan, cfg)
@@ -498,6 +499,56 @@ class Runner:
                 for l, s in zip(leaves, spec_leaves)
             ]
             self.state = jax.tree_util.tree_unflatten(treedef, placed)
+        # -- double-buffered H2D (StreamConfig.h2d_depth) -----------------
+        # packed batches stage onto the device via an async device_put
+        # up to _h2d_ahead steps before the step that consumes them, so
+        # batch N+1's transfer crosses the wire while batch N's group
+        # fetch blocks the host. Forced synchronous (ahead = 0) under
+        # multi-host (the gshard path IS the transfer), for programs
+        # whose emissions read live state, and when max_fires_per_step
+        # interleaves drain steps with fed batches (a staged batch would
+        # run after drain steps that must follow it).
+        stage_ok = (
+            not self._multiproc
+            and not self.program.emissions_reference_state
+            and cfg.max_fires_per_step is None
+        )
+        self._h2d_ahead = max(0, cfg.h2d_depth - 1) if stage_ok else 0
+        self._upload_q: List[tuple] = []
+        self._h2d_sharding = None
+        mesh = getattr(self.program, "mesh", None)
+        if self._h2d_ahead and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import AXIS
+
+            # stage batch-shaped leaves already row-sharded so the jit
+            # dispatch doesn't pay a reshard copy on the mesh
+            self._h2d_sharding = NamedSharding(mesh, P(AXIS))
+        # -- device-side output compaction (compaction_capacity) ----------
+        # mask-carrying emission streams also return a gathered
+        # [capacity] copy of their emitted rows + the row indices, so a
+        # firing step fetches ~count rows instead of full [B] buffers.
+        # Off under multi-host (the chain merge and _fetch_local need the
+        # dense per-process buffers), for live-state programs, and on
+        # multi-device meshes: gathering shard-local emission buffers
+        # into the replicated compact leaves inserts an all-gather whose
+        # per-step rendezvous cost dwarfs the fetch saving.
+        self._compact_cap = (
+            int(cfg.compaction_capacity)
+            if cfg.compaction_capacity
+            and cfg.parallelism <= 1
+            and not self._multiproc
+            and not self.program.emissions_reference_state
+            else 0
+        )
+        self._spilled_streams: set = set()
+        # wire-traffic series: bytes the executor actually moves each
+        # way (null instruments when obs is off)
+        self._h2d_bytes = self.obs.counter("h2d_bytes_total")
+        self._fetch_bytes = self.obs.counter("fetch_bytes_total")
+        self._spill_counter = self.obs.counter("compaction_spills")
+        self._compaction_gauge = self.obs.gauge("compaction_ratio")
         # chained stages: emissions feed the downstream runner as
         # columnar batches instead of the sinks (build_plan_chain).
         # Entry shape per step: single-host (cols, ts_or_None);
@@ -538,6 +589,11 @@ class Runner:
             # between pumps, read only at snapshot time
             self.obs.gauge("chain_buffer_entries").set_fn(
                 lambda: len(self._chain_buf) + len(self._chain_rows)
+            )
+            # total pipeline depth in use: staged uploads + steps whose
+            # emissions are still in flight (lazy; snapshot-time read)
+            self.obs.gauge("pipeline_occupancy").set_fn(
+                lambda: len(self._upload_q) + len(self._inflight)
             )
             if self.program.n_shards > 1:
                 from ..parallel.exchange import exchange_capacity
@@ -773,33 +829,90 @@ class Runner:
         return self._pack(cols, valid, ts)
 
     _I32_SPAN = 0x7FFF_FFFF
+    _U16_SPAN = 0xFFFF
+
+    def _initial_modes(self):
+        """Sticky per-column wire mode chains (narrowest first):
+        int64 -> d16 (uint16 delta) -> d32 (int32 delta) -> raw;
+        float64 -> f32 (exact-round-trip float32) -> raw;
+        interned string ids (int32) -> i16 -> raw;
+        bool columns and the valid mask -> bits (8 rows/byte).
+        A demoted column stays demoted (at most one recompile each)."""
+        compress = self.cfg.h2d_compress
+        # bit-packing changes the wire leaf's leading dim from [B] to
+        # [B/8]; the multi-host gshard split slices rows per process, so
+        # those leaves must keep one element per row there
+        packed = self.cfg.packed_wire and not self._multiproc
+        i64_mode = (
+            "d16" if compress and packed else "d32" if compress else "raw"
+        )
+        modes = []
+        for k in self.in_kinds:
+            if k == "i64":
+                modes.append(i64_mode)
+            elif k == "f64" and packed:
+                modes.append("f32")
+            elif k == STR and packed:
+                modes.append("i16")
+            elif k == "bool" and packed:
+                modes.append("bits")
+            else:
+                modes.append("raw")
+        self._col_modes = tuple(modes)
+        self._ts_mode = i64_mode
+        self._valid_mode = "bits" if packed else "raw"
 
     def _pack(self, cols, valid, ts):
-        """Numpy-side delta packing per the sticky column modes; demotes
-        a column to raw (and rebuilds the step once) when a batch's
-        valid-row span no longer fits int32."""
+        """Numpy-side wire packing per the sticky column modes
+        (h2d_compress delta coding + packed_wire narrowing); demotes a
+        column down its mode chain — and rebuilds the step once — when
+        a batch's valid rows no longer fit the narrow form."""
         if self._col_modes is None:
-            compress = self.cfg.h2d_compress
-            self._col_modes = tuple(
-                "d32" if compress and k == "i64" else "raw"
-                for k in self.in_kinds
-            )
-            self._ts_mode = "d32" if compress else "raw"
+            self._initial_modes()
         all_valid = bool(valid.all())
         any_valid = all_valid or bool(valid.any())
 
         def pack_one(arr, mode):
-            if mode != "d32":
-                return arr, np.int64(0), mode
-            if not any_valid:
-                return np.zeros(arr.shape, np.int32), np.int64(0), mode
-            va = arr if all_valid else arr[valid]
-            lo = va.min()
-            # Python-int span: an int64 subtraction could wrap for
-            # full-range columns and silently pass the check
-            if int(va.max()) - int(lo) > self._I32_SPAN:
+            if mode in ("d32", "d16"):
+                if not any_valid:
+                    z = np.zeros(
+                        arr.shape, np.uint16 if mode == "d16" else np.int32
+                    )
+                    return z, np.int64(0), mode
+                va = arr if all_valid else arr[valid]
+                lo = va.min()
+                # Python-int span: an int64 subtraction could wrap for
+                # full-range columns and silently pass the check
+                span = int(va.max()) - int(lo)
+                if mode == "d16" and span <= self._U16_SPAN:
+                    # invalid/padded rows wrap mod 2^16 — same masked-
+                    # garbage contract as d32's wrap, nothing reads them
+                    return (arr - lo).astype(np.uint16), np.int64(lo), mode
+                if span <= self._I32_SPAN:
+                    mode = "d32" if self.cfg.h2d_compress else "raw"
+                    if mode == "d32":
+                        return (arr - lo).astype(np.int32), np.int64(lo), mode
                 return arr, np.int64(0), "raw"
-            return (arr - lo).astype(np.int32), np.int64(lo), mode
+            if mode == "f32":
+                f = arr.astype(np.float32)
+                back = f.astype(np.float64)
+                ok = back == arr  # NaN demotes: conservative, lossless
+                if bool(ok.all() if all_valid else ok[valid].all()):
+                    return f, np.int64(0), mode
+                return arr, np.int64(0), "raw"
+            if mode == "i16":
+                va = arr if all_valid else arr[valid]
+                if not any_valid or (
+                    int(va.min()) >= -0x8000 and int(va.max()) <= 0x7FFF
+                ):
+                    return arr.astype(np.int16), np.int64(0), mode
+                return arr, np.int64(0), "raw"
+            if mode == "bits":
+                # 8 rows/byte; the step unpacks with a shift table and
+                # slices back to batch_size (bits is lossless — never
+                # demotes)
+                return np.packbits(arr.astype(bool)), np.int64(0), mode
+            return arr, np.int64(0), mode
 
         packed, bases, modes = [], [], []
         for arr, mode in zip(cols, self._col_modes):
@@ -809,12 +922,19 @@ class Runner:
             modes.append(m)
         ts_p, ts_b, ts_m = pack_one(ts, self._ts_mode)
         if tuple(modes) != self._col_modes or ts_m != self._ts_mode:
+            # staged uploads were packed (and will be expanded) under the
+            # OLD layout: run them against the old step before it rebuilds
+            self._flush_uploads()
             self._col_modes, self._ts_mode = tuple(modes), ts_m
             self._recompile_cause = "batch_shape_change"
             self.step = None  # rebuild for the demoted layout
             self._empty_cache = None
             return self._pack(cols, valid, ts)
-        return tuple(packed), tuple(bases), valid, ts_p, ts_b
+        if self._valid_mode == "bits":
+            valid_p = np.packbits(valid)
+        else:
+            valid_p = valid
+        return tuple(packed), tuple(bases), valid_p, ts_p, ts_b
 
     def _ensure_step(self):
         if self.step is None:
@@ -896,7 +1016,7 @@ class Runner:
                 inputs = self._device_inputs(
                     padded, self.plan.time_characteristic
                 )
-            self._run_step(inputs, wm_lower, t_batch)
+            self._stage_step(inputs, wm_lower, t_batch)
             if self.count_input:
                 self.metrics.records_in += int(sub.n)
                 self.obs.records_in.inc(int(sub.n))
@@ -906,12 +1026,89 @@ class Runner:
             # per-step latency bound holds while no fire is ever lost
             self._drain(wm_lower, t_batch)
 
+    @staticmethod
+    def _wire_nbytes(inputs) -> int:
+        """Wire bytes of one packed step input (the h2d_bytes_total
+        series): packed columns + valid + ts; the per-column base
+        scalars ride along as 8 bytes each."""
+        packed, bases, valid, ts_p, _ts_b = inputs
+        return (
+            sum(int(p.nbytes) for p in packed)
+            + int(valid.nbytes)
+            + int(ts_p.nbytes)
+            + 8 * (len(bases) + 1)
+        )
+
+    def _stage_step(self, inputs, wm_lower: int, t_batch=None):
+        """Run one packed batch through the upload side of the pipeline:
+        at h2d_depth 1 (or when staging is disabled) the step runs
+        immediately and the transfer rides the dispatch; deeper, the
+        batch's device_put is issued NOW (async) and the step runs up to
+        _h2d_ahead feeds later — by which point the transfer has crossed
+        the wire behind the previous steps' blocking fetches."""
+        if self.obs.enabled:
+            self._h2d_bytes.inc(self._wire_nbytes(inputs))
+        if not self._h2d_ahead:
+            self._run_step(inputs, wm_lower, t_batch)
+            return
+        packed, bases, valid, ts_p, ts_b = inputs
+        with self.obs.span("h2d", self._step_idx + len(self._upload_q) + 1):
+            put = (
+                jax.device_put
+                if self._h2d_sharding is None
+                else self._sharded_put
+            )
+            packed, valid, ts_p = put((packed, valid, ts_p))
+        # markers detach at stage time so they ride THIS batch's step,
+        # not whichever older batch the staging queue pops next
+        if self._pending_markers:
+            markers = self._pending_markers
+            self._pending_markers = []
+        else:
+            markers = None
+        self._upload_q.append(
+            ((packed, bases, valid, ts_p, ts_b), wm_lower, t_batch, markers)
+        )
+        while len(self._upload_q) > self._h2d_ahead:
+            self._pop_upload()
+
+    def _sharded_put(self, tree):
+        """device_put for staged batches on a single-process mesh:
+        row-shaped leaves place pre-sharded along the batch axis
+        (anything the axis doesn't divide falls back to the default
+        placement and lets the jit dispatch reshard it)."""
+        n = self.program.n_shards
+
+        def put(a):
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] % n == 0:
+                return jax.device_put(a, self._h2d_sharding)
+            return jax.device_put(a)
+
+        return jax.tree_util.tree_map(put, tree)
+
+    def _pop_upload(self):
+        inputs, wm_lower, t_batch, markers = self._upload_q.pop(0)
+        self._run_step(
+            inputs, wm_lower, t_batch,
+            markers=() if markers is None else markers,
+        )
+
+    def _flush_uploads(self):
+        """Run every staged batch's step (pipeline barrier: checkpoint,
+        rule update, key growth, wire-layout demotion, EOS)."""
+        while self._upload_q:
+            self._pop_upload()
+
     def flush(self, wm_lower: int, t_batch: Optional[float] = None):
         """Advance time with an empty batch (processing-time tick / EOS).
 
         Window programs fire at most ``max_fires_per_step`` window ends
         per step (bounding fire-step latency); the loop here drains any
         deferred ends until ``state["pending_fires"]`` reaches zero."""
+        # staged batches must step before any clock tick: an empty step
+        # jumping ahead of a staged data batch would fire its windows
+        # from a pre-batch state
+        self._flush_uploads()
         if not self.program.fires_on_clock:
             return
         if t_batch is None:
@@ -934,22 +1131,83 @@ class Runner:
         self._drain(wm_lower, t_batch)
 
     def _counted_step(self, inner):
-        """Wrap the program's jitted step to (a) re-expand delta-packed
-        int64 columns on device and (b) also return one scalar count per
-        emission stream, so the host can skip fetching the batch-sized
-        emission buffers of a step that emitted nothing — on a step with
-        no alerts the only D2H traffic is these scalars."""
+        """Wrap the program's jitted step to (a) decode the packed wire
+        format on device (delta expansion, dtype widening, bit
+        unpacking), (b) also return one scalar count per emission
+        stream, so the host can skip fetching the batch-sized emission
+        buffers of a step that emitted nothing — on a step with no
+        alerts the only D2H traffic is these scalars — and (c) gather
+        each firing stream's emitted rows into a small [capacity]
+        buffer (device-side output compaction), so a firing step
+        fetches ~count rows instead of full [B] outputs."""
         col_modes, ts_mode = self._col_modes, self._ts_mode
+        valid_mode = self._valid_mode
+        n_rows = self.cfg.batch_size
+        compact_cap = self._compact_cap
+        skip_main_compact = (
+            self.program.main_emission_prefix and self.cfg.parallelism <= 1
+        )  # single-chip prefix buffers are already compact (sliced fetch)
+
+        def unpack_bits(p):
+            bits = (
+                p[:, None] >> jnp.arange(7, -1, -1, dtype=jnp.uint8)
+            ) & jnp.uint8(1)
+            return bits.reshape(-1)[:n_rows].astype(jnp.bool_)
 
         def expand(p, b, mode):
-            if mode != "d32":
-                return p
-            return p.astype(jnp.int64) + b
+            if mode in ("d32", "d16"):
+                return p.astype(jnp.int64) + b
+            if mode == "f32":
+                return p.astype(jnp.float64)
+            if mode == "i16":
+                return p.astype(jnp.int32)
+            if mode == "bits":
+                return unpack_bits(p)
+            return p
+
+        def compact_stream(stream):
+            """Gather one stream's emitted rows (emission order) into
+            [compact_cap] buffers: row indices + every [B]-shaped leaf,
+            pre-gathered so the host fetch is count-sized. Rows past the
+            capacity are simply absent — the host spills to the full
+            fetch when count > capacity (exact at any density)."""
+            from ..ops import panes as pane_ops
+
+            mask = stream["mask"]
+            order = stream.get("order")
+            nb = mask.shape[0]
+            if order is not None:
+                # rolling/count programs emit in device-internal order
+                # with a permutation leaf; emission order is ascending j
+                # where mask[order[j]] — gather through it so the
+                # compact rows land dispatch-ready
+                perm_valid = mask[order]
+                pos, _cnt = pane_ops.compact_positions(
+                    perm_valid, compact_cap
+                )
+                sel = order[pos]
+            else:
+                sel, _cnt = pane_ops.compact_positions(mask, compact_cap)
+
+            def gather(a):
+                if getattr(a, "ndim", 0) >= 1 and a.shape[0] == nb:
+                    return a[sel]
+                return a
+
+            comp = {
+                k: jax.tree_util.tree_map(gather, v)
+                for k, v in stream.items()
+                if k not in ("mask", "order")
+            }
+            comp["__sel__"] = sel.astype(jnp.int32)
+            return comp
 
         def step(state, packed, bases, valid, ts_p, ts_b, wm_lower):
             cols = tuple(
                 expand(p, b, m) for p, b, m in zip(packed, bases, col_modes)
             )
+            if valid_mode == "bits":
+                valid = unpack_bits(valid)
             ts = expand(ts_p, ts_b, ts_mode)
             state, em = inner(state, cols, valid, ts, wm_lower)
             counts = {}
@@ -958,7 +1216,15 @@ class Runner:
                     counts[name] = stream["mask"].sum(dtype=jnp.int32)
                 elif "fire" in stream:
                     counts[name] = stream["fire"].sum(dtype=jnp.int32)
-            return state, em, counts
+            compact = {}
+            if compact_cap:
+                for name, stream in em.items():
+                    if "mask" not in stream:
+                        continue
+                    if name == "main" and skip_main_compact:
+                        continue
+                    compact[name] = compact_stream(stream)
+            return state, em, counts, compact
 
         if self._compile_obs is not None:
             cause = self._recompile_cause
@@ -969,8 +1235,11 @@ class Runner:
             )
         return jax.jit(step, donate_argnums=0)
 
-    def _run_step(self, inputs, wm_lower: int, t_batch=None):
-        """One jitted step + emission dispatch (the only step call site)."""
+    def _run_step(self, inputs, wm_lower: int, t_batch=None, markers=None):
+        """One jitted step + emission dispatch (the only step call site).
+
+        ``markers`` is the staged-upload path handing over the markers it
+        detached at stage time; None means take the pending ones here."""
         self._ensure_step()
         if self._fault is not None:
             self._fault("device_step")
@@ -990,7 +1259,7 @@ class Runner:
         self._flight.set_active(self.obs.name or self.program.operator_name)
         with self.obs.span("dispatch", self._step_idx):
             with Stopwatch() as sw:
-                self.state, emissions, counts = self.step(
+                self.state, emissions, counts, compact = self.step(
                     self.state, packed, bases, valid, ts_p, ts_b,
                     jnp.asarray(wm_lower, jnp.int64),
                 )
@@ -1007,12 +1276,16 @@ class Runner:
         # the entry as a live reference, or markers accepted while this
         # step is in flight would appear in it retroactively AND drain
         # into a later step — recording twice
-        if self._pending_markers:
+        if markers is not None:
+            step_markers = markers
+        elif self._pending_markers:
             step_markers = self._pending_markers
             self._pending_markers = []
         else:
             step_markers = ()
-        self._inflight.append((emissions, counts, t_batch, step_markers))
+        self._inflight.append(
+            (emissions, counts, compact, t_batch, step_markers)
+        )
         self.obs.inflight.set(len(self._inflight))
         while len(self._inflight) > self._max_inflight:
             g = self._fetch_group
@@ -1037,7 +1310,9 @@ class Runner:
 
     def drain_inflight(self):
         """Dispatch every pending step's emissions (checkpoint barrier /
-        end of stream)."""
+        end of stream). Staged uploads step first — their batches are
+        consumed-but-unstepped and a barrier must settle them too."""
+        self._flush_uploads()
         if self._inflight:
             entries, self._inflight = self._inflight, []
             g = self._fetch_group
@@ -1347,10 +1622,13 @@ class Runner:
             r.pump_chain(proc_now)
             r = r.downstream
 
-    def _plan_fetch(self, emissions, cnts) -> dict:
+    def _plan_fetch(self, emissions, compact, cnts) -> dict:
         """The emission streams worth fetching for one step, given its
-        host-side count scalars (skip empty streams; slice prefix-
-        compacted buffers to ~count rows)."""
+        host-side count scalars: skip empty streams, slice prefix-
+        compacted buffers to ~count rows, and swap in the device-
+        compacted form (count-sized, pre-gathered) when the count fits
+        its capacity — past it, spill to the classic full fetch so
+        semantics hold at any alert density."""
         fetch = {}
         tt = getattr(self.program, "timeout_tag", None)
         for name, stream in emissions.items():
@@ -1377,6 +1655,35 @@ class Runner:
                 cap = int(stream["mask"].shape[0])
                 b = min(cap, 1 << max(4, (int(c) - 1).bit_length()))
                 stream = self._slice_stream(stream, b, cap)
+            elif name in compact:
+                if int(c) <= self._compact_cap:
+                    # count-sized fetch: slice the [capacity] compact
+                    # buffers to the pow2 bucket past the count (same
+                    # bucketing as the prefix path bounds the number of
+                    # device slice programs)
+                    b = min(
+                        self._compact_cap,
+                        1 << max(4, (int(c) - 1).bit_length()),
+                    )
+                    comp = self._slice_stream(
+                        compact[name], b, self._compact_cap
+                    )
+                    comp["__n__"] = int(c)
+                    fetch[name] = comp
+                    continue
+                # spill: denser than the compact buffer — fall through
+                # to the exact full fetch, leave a breadcrumb (first
+                # spill per stream) and count every occurrence
+                self._spill_counter.inc()
+                if name not in self._spilled_streams:
+                    self._spilled_streams.add(name)
+                    self._flight.record(
+                        "compaction_spill",
+                        operator=self.obs.name or self.program.operator_name,
+                        stream=name,
+                        count=int(c),
+                        capacity=self._compact_cap,
+                    )
             fetch[name] = stream
         return fetch
 
@@ -1435,10 +1742,10 @@ class Runner:
                 )
                 cnts_list = [cnts0]
             else:
-                cnts_list = jax.device_get([c for _, c, _, _ in entries])
+                cnts_list = jax.device_get([c for _, c, _, _, _ in entries])
             fetches = [
-                self._plan_fetch(em, cnts)
-                for (em, _, _, _), cnts in zip(entries, cnts_list)
+                self._plan_fetch(em, comp, cnts)
+                for (em, _, comp, _, _), cnts in zip(entries, cnts_list)
             ]
             pre_fetched: List[dict] = [{} for _ in fetches]
             if self._spec_eligible(entries):
@@ -1461,6 +1768,8 @@ class Runner:
                 ]
             else:
                 fetched_list = jax.device_get(fetches)
+        if self.obs.enabled:
+            self._account_fetch(entries, fetches, fetched_list)
         # one sample PER STEP, not per fetch group: the group's blocking
         # wait divides evenly across its entries, so the histogram's
         # percentiles stay comparable across fetch_group settings while
@@ -1470,9 +1779,34 @@ class Runner:
         self.obs.step_time_s.observe_many([per_entry] * len(entries))
         for (entry, pre, fetched) in zip(entries, pre_fetched, fetched_list):
             fetched.update(pre)
-            self._dispatch(fetched, entry[2])
-            if entry[3]:
-                self._record_markers(entry[3])
+            self._dispatch(fetched, entry[3])
+            if entry[4]:
+                self._record_markers(entry[4])
+
+    def _account_fetch(self, entries, fetches, fetched_list):
+        """fetch_bytes_total / compaction_ratio bookkeeping (obs-enabled
+        runs only): actually-fetched bytes vs what the same streams
+        would have cost as full [B] buffers. Ratio < 1 means the
+        compaction/prefix slicing is cutting D2H wire bytes."""
+
+        def nbytes(tree):
+            return sum(
+                int(a.nbytes)
+                for a in jax.tree_util.tree_leaves(tree)
+                if hasattr(a, "nbytes")
+            )
+
+        fetched_b = sum(nbytes(f) for f in fetched_list)
+        # the count scalars fetch every step regardless
+        fetched_b += sum(4 * len(e[1]) for e in entries)
+        self._fetch_bytes.inc(fetched_b)
+        full_b = sum(
+            nbytes(entry[0].get(name))
+            for entry, plan in zip(entries, fetches)
+            for name in plan
+        )
+        if full_b:
+            self._compaction_gauge.set(fetched_b / full_b)
 
     def finalize_metrics(self):
         """Fold the device-side cumulative counters into Metrics (one
@@ -1572,6 +1906,45 @@ class Runner:
             if keep:
                 sink.emit(item, subtask=subtask)
 
+    def _stream_rows(self, stream):
+        """Resolve one fetched emission stream to its emitted rows:
+        returns ``(sel, take, j_valid)`` where ``sel`` is the row
+        indices in emission order, ``take(leaf)`` gathers any
+        [B]-shaped leaf to those rows, and ``j_valid`` is the
+        emission-order positions (order-carrying streams only; the
+        multi-host merge key). Device-compacted streams (``__n__``)
+        arrive pre-gathered, so ``take`` is just a count slice; full
+        streams gather through the mask (un-permuting via the
+        ``order`` leaf when the program emits one)."""
+        n = stream.get("__n__")
+        if n is not None:
+            n = int(n)
+            sel = np.asarray(stream["__sel__"])[:n]
+
+            def take(a):
+                return np.asarray(a)[:n]
+
+            return sel, take, None
+        mask = np.asarray(stream["mask"])
+        order = stream.get("order")
+        if order is not None:
+            # device emitted rows in its internal (sorted) order;
+            # order[j] is post-exchange row j's position — un-permute
+            # HERE, off the device critical path (numpy gather).
+            # Order values address the GLOBAL stacked buffer; under
+            # multi-host each process fetched only its slice.
+            order = np.asarray(order) - self._local_row_base(mask.shape[0])
+            j_valid = np.nonzero(mask[order])[0]
+            sel = order[j_valid]
+        else:
+            j_valid = None
+            sel = np.nonzero(mask)[0]
+
+        def take(a):
+            return np.asarray(a)[sel]
+
+        return sel, take, j_valid
+
     def _dispatch(self, emissions, t_batch=None):
         with self.obs.span("emit", self._step_idx):
             self._dispatch_inner(emissions, t_batch)
@@ -1596,20 +1969,7 @@ class Runner:
                 self.obs.counter("window_fires").inc(fired)
         main = emissions.get("main")
         if main is not None:
-            mask = np.asarray(main["mask"])
-            order = main.get("order")
-            if order is not None:
-                # device emitted rows in its internal (sorted) order;
-                # order[j] is post-exchange row j's position — un-permute
-                # HERE, off the device critical path (numpy gather).
-                # Order values address the GLOBAL stacked buffer; under
-                # multi-host each process fetched only its slice.
-                order = np.asarray(order) - self._local_row_base(mask.shape[0])
-                j_valid = np.nonzero(mask[order])[0]
-                sel = order[j_valid]
-            else:
-                j_valid = None
-                sel = np.nonzero(mask)[0]
+            sel, take, j_valid = self._stream_rows(main)
             if self._multiproc and self.downstream is not None:
                 # multi-host chain: buffer the LOCAL rows with their
                 # global order keys, even when this process has none
@@ -1619,27 +1979,29 @@ class Runner:
                 # stages order by global post-exchange row index, which
                 # reconstructs the single-process hand-off order (each
                 # process's rows ARE its shards' region of the global
-                # row space).
-                cols = [np.asarray(c)[sel] for c in main["cols"]]
+                # row space). Compacted streams never reach here —
+                # compaction is disabled under multi-host.
+                cols = [take(c) for c in main["cols"]]
                 wend = main.get("window_end")
                 if wend is not None:
                     self._chain_buf.append(("win", cols,
-                        np.asarray(wend)[sel],
-                        np.asarray(main["key"])[sel],
+                        take(wend),
+                        take(main["key"]),
                     ))
                 else:
-                    gorder = (
-                        j_valid + self._local_row_base(order.shape[0])
-                    ).astype(np.int64)
+                    base = self._local_row_base(
+                        np.asarray(main["mask"]).shape[0]
+                    )
+                    gorder = (j_valid + base).astype(np.int64)
                     tsarr = main.get("ts")
                     ets = (
-                        np.asarray(tsarr)[sel]
+                        take(tsarr)
                         if (self._chain_ts and tsarr is not None)
                         else None
                     )
                     self._chain_buf.append(("arr", cols, gorder, ets))
             elif sel.size:
-                cols = [np.asarray(c)[sel] for c in main["cols"]]
+                cols = [take(c) for c in main["cols"]]
                 if self.downstream is not None:
                     # chained stage: hand the columnar emissions straight
                     # to the next runner (no Python rows in between).
@@ -1648,6 +2010,7 @@ class Runner:
                     # aggregates forward the record timestamp.
                     wend = main.get("window_end")
                     kcol = main.get("key")
+                    w_rows = take(wend) if wend is not None else None
                     if (
                         wend is not None
                         and kcol is not None
@@ -1658,22 +2021,21 @@ class Runner:
                         # rows of DIFFERENT stage-1 keys that share a
                         # stage-2 key; the single-chip fire path emits
                         # end-major then key, so sort to match it
-                        w = np.asarray(wend)[sel]
-                        kk = np.asarray(kcol)[sel]
-                        o = np.lexsort((kk, w))
-                        sel = sel[o]
+                        kk = take(kcol)
+                        o = np.lexsort((kk, w_rows))
+                        w_rows = w_rows[o]
                         cols = [c[o] for c in cols]
                     ts_rows = None
                     if self._chain_ts:
                         if wend is not None:
-                            ts_rows = np.asarray(wend)[sel] - 1
+                            ts_rows = w_rows - 1
                         else:
-                            ts_rows = np.asarray(main["ts"])[sel]
+                            ts_rows = take(main["ts"])
                     self._chain_buf.append((cols, ts_rows))
                 else:
                     subtask = main.get("subtask")
                     subtask = (
-                        np.asarray(subtask)[sel] if subtask is not None else None
+                        take(subtask) if subtask is not None else None
                     )
                     for j, row in enumerate(self.formatter.rows(cols)):
                         st = int(subtask[j]) if subtask is not None else None
@@ -1697,11 +2059,10 @@ class Runner:
         # late-drop COUNTING happens on device (state["late_dropped"], so
         # jobs without a side output still observe drops); this path only
         # feeds the configured side sinks
-        mask = np.asarray(late["mask"])
-        sel = np.nonzero(mask)[0]
+        sel, take, _ = self._stream_rows(late)
         if not sel.size:
             return
-        cols = [np.asarray(c)[sel] for c in late["cols"]]
+        cols = [take(c) for c in late["cols"]]
         fmt = EmissionFormatter(
             self.program.mid_kinds, self.program.mid_tables
         )
@@ -1722,11 +2083,10 @@ class Runner:
         entry = self.side_sinks.get(tt.id) if tt is not None else None
         if entry is None:
             return
-        mask = np.asarray(timeout["mask"])
-        sel = np.nonzero(mask)[0]
+        sel, take, _ = self._stream_rows(timeout)
         if not sel.size:
             return
-        cols = [np.asarray(c)[sel] for c in timeout["cols"]]
+        cols = [take(c) for c in timeout["cols"]]
         fmt = EmissionFormatter(
             self.program.timeout_kinds, self.program.timeout_tables
         )
