@@ -28,6 +28,7 @@ window sums still compose in int64 at fire.
 Prints ONE JSON line: metric/value/unit/vs_baseline. Detail -> stderr.
 """
 
+import hashlib
 import json
 import sys
 import time
@@ -1049,6 +1050,17 @@ def device_cep(stream_hash, B_p=1 << 17, key_counts=(1 << 14, 1 << 17),
     return dict(batch=B_p, within_ms=WITHIN_MS, sweep=sweep)
 
 
+def _sink_digest(rows):
+    """Order-insensitive content hash of a sink's emissions. Pipeline
+    depths change WHEN windows fire relative to the feed loop, never
+    WHAT fires, so the sorted-repr digest is the right equality."""
+    h = hashlib.sha256()
+    for r in sorted(repr(x) for x in rows):
+        h.update(r.encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
 def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
                         pipelined=True):
     """Stage-attributed account of the full execute_job path (VERDICT r3
@@ -1073,7 +1085,7 @@ def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
     from tpustream.runtime.metrics import Metrics
     from tpustream.runtime.plan import build_plan_chain
 
-    def make_runner(cfg):
+    def make_runner(cfg, job_obs=None):
         env = StreamExecutionEnvironment(cfg)
         env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
         sink = []
@@ -1082,7 +1094,14 @@ def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
             slide=Time.seconds(1),
         ).add_sink(lambda r: sink.append(r))
         plan = build_plan_chain(env, env._sinks)[0]
-        return HostStage(plan, cfg), Runner(plan, cfg, Metrics())
+        if job_obs is None:
+            metrics = Metrics()
+        else:
+            metrics = Metrics(
+                registry=job_obs.registry, job_name=job_obs.job_name
+            )
+            metrics.job_obs = job_obs
+        return HostStage(plan, cfg), Runner(plan, cfg, metrics), sink, metrics
 
     def parse_batch(host, sb):
         """Native raw-bytes lane, falling back to the line path where
@@ -1099,7 +1118,7 @@ def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
         batch_size=bl, key_capacity=nkey, alert_capacity=1 << 16,
         async_depth=1, max_batch_delay_ms=0.0,
     )
-    host, runner = make_runner(cfg)
+    host, runner, _, _ = make_runner(cfg)
 
     src = _GenBytesSource(tpl, tcols, n_batches + 3, 0, bl, 1_566_957_600_000)
     t_parse, t_pack, t_feed, t_rtt = [], [], [], []
@@ -1170,12 +1189,13 @@ def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
     # compaction) over the same batches; ms/batch here is the overlapped
     # steady-state cost the flood actually pays
     pipelined_ms = pipelined_rate = None
+    baseline_sha = None
     if pipelined:
         cfg2 = StreamConfig(
             batch_size=bl, key_capacity=nkey, alert_capacity=1 << 16,
             max_batch_delay_ms=0.0,
         )
-        host2, runner2 = make_runner(cfg2)
+        host2, runner2, sink2, _ = make_runner(cfg2)
         src2 = _GenBytesSource(
             tpl, tcols, n_batches + 3, 0, bl, 1_566_957_600_000
         )
@@ -1188,12 +1208,99 @@ def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
             if b2 == 3:  # warm batches compiled + drained; clock starts
                 runner2.drain_inflight()
                 t_start = time.perf_counter()
-            runner2.feed(batch, wm_lower)
+            # real watermark progress (each buffer = one stream second)
+            # so windows fire and the pass pays the emission path the
+            # flood pays — and leaves sink bytes to hold against the
+            # controller-on pass below
+            runner2.feed(batch, int(np.asarray(batch.ts).max()))
             b2 += 1
         runner2.drain_inflight()
         if t_start is not None and b2 > 3:
             pipelined_ms = (time.perf_counter() - t_start) / (b2 - 3) * 1e3
             pipelined_rate = bl / (pipelined_ms / 1e3)
+        baseline_sha = _sink_digest(sink2)
+
+    # controller-on pass: same shape again with the obs layer live and
+    # the AdaptiveController driven at batch barriers (the bench stands
+    # in for the Snapshotter tick). The contract under test: knobs move
+    # only inside bounds, every move is a flight event + controller_*
+    # series, and the sink bytes match the controller-off pass exactly —
+    # depths overlap work, they never change results.
+    controller_report = None
+    if pipelined:
+        from tpustream.config import ObsConfig
+        from tpustream.obs.runtime import JobObs
+        from tpustream.runtime.controller import AdaptiveController
+
+        obs_cfg = ObsConfig(
+            enabled=True, adaptive=True, adaptive_cooldown_ticks=0,
+        )
+        cfg3 = StreamConfig(
+            batch_size=bl, key_capacity=nkey, alert_capacity=1 << 16,
+            max_batch_delay_ms=0.0, obs=obs_cfg,
+        )
+        job_obs3 = JobObs(obs_cfg, job_name="decompose")
+        host3, runner3, sink3, metrics3 = make_runner(cfg3, job_obs3)
+        controller = AdaptiveController(cfg3, job_obs3)
+        src3 = _GenBytesSource(
+            tpl, tcols, n_batches + 3, 0, bl, 1_566_957_600_000
+        )
+        b3 = 0
+        t_start3 = None
+        for sb in src3.batches(bl, 0.0):
+            if sb.final:
+                break
+            batch = parse_batch(host3, sb)
+            if b3 == 3:
+                runner3.drain_inflight()
+                t_start3 = time.perf_counter()
+            runner3.feed(batch, int(np.asarray(batch.ts).max()))
+            if b3 >= 3:  # tick once per steady-state batch
+                knobs = controller.on_tick()
+                if knobs:
+                    runner3.drain_inflight()
+                    for r in runner3.chain():
+                        r.apply_knobs(knobs)
+            b3 += 1
+        runner3.drain_inflight()
+        ctl_ms = ctl_rate = None
+        if t_start3 is not None and b3 > 3:
+            ctl_ms = (time.perf_counter() - t_start3) / (b3 - 3) * 1e3
+            ctl_rate = bl / (ctl_ms / 1e3)
+        summary3 = controller.summary()
+        prof = {}
+        if job_obs3.profiler is not None:
+            prof = job_obs3.profiler.profile()
+        lat3 = sorted(metrics3.emit_latencies_s)
+        p99_ms3 = (
+            float(np.percentile(lat3, 99) * 1e3) if lat3 else None
+        )
+        output_sha = _sink_digest(sink3)
+        controller_report = dict(
+            converged=controller.converged(),
+            bounds=summary3["bounds"],
+            decisions=summary3["decisions"],
+            reverts=summary3["reverts"],
+            p99_ms=p99_ms3,
+            ms_per_batch=ctl_ms,
+            rows_per_s=ctl_rate,
+            binding_stage=prof.get("binding_stage"),
+            binding_share=prof.get("binding_share"),
+            output_sha=output_sha,
+            baseline_sha=baseline_sha,
+        )
+        knob_txt = ", ".join(
+            f"{k}={v}" for k, v in sorted(controller.converged().items())
+        )
+        log(
+            f"phase F detail: controller-on pass converged to {knob_txt} "
+            f"after {summary3['decisions']} decisions "
+            f"({summary3['reverts']} reverts), emit p99 "
+            f"{0.0 if p99_ms3 is None else p99_ms3:.1f} ms, output "
+            f"{'MATCHES' if output_sha == baseline_sha else 'DIVERGES FROM'}"
+            f" the controller-off pass"
+        )
+        job_obs3.close(dump=False)
 
     return dict(
         rows_per_batch=bl,
@@ -1206,6 +1313,7 @@ def decompose_full_path(n_batches=10, bl=1 << 16, nkey=1 << 20,
         binding_ms=binding[1],
         pipelined_ms_per_batch=pipelined_ms,
         pipelined_rows_per_s=pipelined_rate,
+        controller=controller_report,
     )
 
 

@@ -128,7 +128,13 @@ def test_histogram_ring_bound_keeps_exact_count_sum():
 
 
 def test_prometheus_text_golden():
+    # pinned clock: epoch aligned so exposition timestamps are exactly
+    # sample-time * 1000 ms — the golden asserts the full line including
+    # the per-series timestamp suffix
     reg = MetricsRegistry()
+    reg.now = lambda: 1.5
+    reg._epoch_wall = 0.0
+    reg._epoch_perf = 0.0
     g = reg.group(job="demo", operator="window")
     g.counter("operator_records_in").inc(42)
     g.gauge("operator_inflight_steps").set(3)
@@ -139,29 +145,35 @@ def test_prometheus_text_golden():
     h.observe_many([0.5, 0.5, 0.5, 0.5])
     assert reg.to_prometheus_text() == (
         '# TYPE tpustream_operator_inflight_steps gauge\n'
-        'tpustream_operator_inflight_steps{job="demo",operator="window"} 3\n'
+        'tpustream_operator_inflight_steps{job="demo",operator="window"} 3 1500\n'
         '# TYPE tpustream_operator_records_in counter\n'
-        'tpustream_operator_records_in{job="demo",operator="window"} 42\n'
+        'tpustream_operator_records_in{job="demo",operator="window"} 42 1500\n'
         '# TYPE tpustream_operator_step_time_s summary\n'
-        'tpustream_operator_step_time_s{job="demo",operator="window",quantile="0.5"} 0.5\n'
-        'tpustream_operator_step_time_s{job="demo",operator="window",quantile="0.9"} 0.5\n'
-        'tpustream_operator_step_time_s{job="demo",operator="window",quantile="0.99"} 0.5\n'
-        'tpustream_operator_step_time_s_sum{job="demo",operator="window"} 2\n'
-        'tpustream_operator_step_time_s_count{job="demo",operator="window"} 4\n'
+        'tpustream_operator_step_time_s{job="demo",operator="window",quantile="0.5"} 0.5 1500\n'
+        'tpustream_operator_step_time_s{job="demo",operator="window",quantile="0.9"} 0.5 1500\n'
+        'tpustream_operator_step_time_s{job="demo",operator="window",quantile="0.99"} 0.5 1500\n'
+        'tpustream_operator_step_time_s_sum{job="demo",operator="window"} 2 1500\n'
+        'tpustream_operator_step_time_s_count{job="demo",operator="window"} 4 1500\n'
     )
+    # back-to-back renders are byte-identical — rendering never advances
+    # any sample clock
+    assert reg.to_prometheus_text() == reg.to_prometheus_text()
 
 
 def test_prometheus_text_escapes_hostile_label_values():
     """Exposition golden for a label value containing every character
     the text format escapes: backslash, double quote, and newline."""
     reg = MetricsRegistry()
+    reg.now = lambda: 2.0
+    reg._epoch_wall = 0.0
+    reg._epoch_perf = 0.0
     reg.group(job="j", operator='he"llo\\wo\nrld').counter(
         "operator_records_in"
     ).inc(1)
     assert reg.to_prometheus_text() == (
         '# TYPE tpustream_operator_records_in counter\n'
         'tpustream_operator_records_in'
-        '{job="j",operator="he\\"llo\\\\wo\\nrld"} 1\n'
+        '{job="j",operator="he\\"llo\\\\wo\\nrld"} 1 2000\n'
     )
 
 

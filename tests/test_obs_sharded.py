@@ -188,3 +188,108 @@ def test_sharded_job_obs_matches_single_chip():
         health = m.obs_snapshot()["health"]
         assert health["rules"][0]["rule"] == "lag_crit"
         assert health["rules"][0]["level"] == "crit"  # 60 s bounded delay
+
+
+# ---------------------------------------------------------------------------
+# time-series merge across p=8 shard registries (satellite: windowed
+# queries over the merged history match a single-chip oracle)
+# ---------------------------------------------------------------------------
+
+
+def _pin(reg, clk):
+    """Put a registry on the shared fake timeline (wall == perf epoch),
+    so cross-registry history merges line up deterministically."""
+    reg.now = lambda: clk[0]
+    reg._epoch_wall = 0.0
+    reg._epoch_perf = 0.0
+    return reg
+
+
+def test_timeseries_merge_p8_matches_single_chip_oracle():
+    """Eight shard registries on one shared timeline, each counting at
+    its own rate and observing its own latencies, merged into one
+    coordinator registry: the merged ``rate()`` equals the sum of the
+    per-shard rates and the merged ``quantile()`` equals a single
+    registry that saw every observation — lossless, not approximate."""
+    clk = [0.0]
+    shards = []
+    oracle = _pin(MetricsRegistry(), clk)
+    oc = oracle.group(job="j").counter("records_in")
+    oh = oracle.group(job="j").histogram("e2e_latency_ms")
+    for i in range(8):
+        r = _pin(MetricsRegistry(), clk)
+        shards.append(r)
+    # mint the shard instruments at t=0 so every zero-anchor shares the
+    # timeline origin
+    scs = [r.group(job="j").counter("records_in") for r in shards]
+    shs = [r.group(job="j").histogram("e2e_latency_ms") for r in shards]
+    for t in range(1, 11):
+        clk[0] = float(t)
+        for i in range(8):
+            scs[i].inc(i + 1)            # shard i ingests (i+1) rows/s
+            oc.inc(i + 1)
+            lat = float(10 * (i + 1) + t % 3)
+            shs[i].observe(lat)
+            oh.observe(lat)
+
+    merged = _pin(MetricsRegistry(), clk)
+    for r in shards:
+        merged.merge(r)
+
+    mc = merged.find("records_in", {"job": "j"})
+    mh = merged.find("e2e_latency_ms", {"job": "j"})
+    # lossless totals
+    assert mc.value == oc.value == 10 * sum(range(1, 9))
+    assert mh.count == oh.count == 80
+    assert mh.sum == pytest.approx(oh.sum)
+    # windowed rate over the merged cumulative history == sum of the
+    # per-shard windowed rates == the oracle's rate
+    per_shard = sum(c.history.rate(9.0) for c in scs)
+    assert mc.history.rate(9.0) == pytest.approx(per_shard)
+    assert mc.history.rate(9.0) == pytest.approx(oc.history.rate(9.0))
+    assert mc.history.rate(9.0) == pytest.approx(float(sum(range(1, 9))))
+    # windowed quantiles over the merged sample history == single-chip
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert mh.history.quantile(q, 9.0) == pytest.approx(
+            oh.history.quantile(q, 9.0)
+        )
+    assert mh.history.mean(9.0) == pytest.approx(oh.history.mean(9.0))
+
+
+def test_sharded_adaptive_controller_output_parity_p8():
+    """p=8 with the adaptive controller ticking at flood rate: sink
+    output identical to the controller-off run, and the controller left
+    its audit trail (series + at least one decision event)."""
+    _, out_off = _run(parallelism=8)
+
+    cfg = StreamConfig(
+        parallelism=8,
+        batch_size=40,
+        key_capacity=64,
+        print_parallelism=1,
+        obs=ObsConfig(
+            enabled=True, adaptive=True, snapshot_interval_s=1e-4,
+            adaptive_cooldown_ticks=0,
+        ),
+    )
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    h = build_et(env, env.add_source(ReplaySource(LINES))).collect()
+    env.execute("obs-sharded-adaptive")
+    out_on = sorted((t.f0, round(t.f1, 12)) for t in h.items)
+    assert out_on == out_off  # depth moves never change results
+
+    names = {
+        s["name"]
+        for s in env.metrics.obs_snapshot()["metrics"]["series"]
+    }
+    for want in (
+        "controller_async_depth", "controller_fetch_group",
+        "controller_h2d_depth", "controller_decisions_total",
+    ):
+        assert want in names, want
+    evs = [
+        e for e in env.metrics.job_obs.flight.events()
+        if e["kind"] == "controller_decision"
+    ]
+    assert evs, "flood-rate ticks must produce at least one decision"

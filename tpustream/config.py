@@ -79,6 +79,46 @@ class ObsConfig:
     # as watermark_jump events — the classic "someone replayed old data /
     # a partition went idle" postmortem breadcrumb
 
+    # -- time series, profiling (obs/timeseries.py, obs/profiler.py) --------
+    timeseries_ring: int = 512
+    # bounded (timestamp, value) history behind every registry series:
+    # windowed rate()/delta()/mean()/quantile() from inside the job.
+    # 0 disables history entirely (point-in-time registry, pre-PR8).
+    timeseries_digest: int = 64
+    # t-digest-style centroids a sample series folds evicted points
+    # into, so long-window quantiles stay approximately right after the
+    # raw ring has turned over
+    histogram_reservoir: int = 4096
+    # raw-sample bound for unbounded (max_samples=0) histograms via
+    # reservoir sampling — count/sum stay exact, the retained samples
+    # become a uniform subsample of the whole run. 0 = truly unbounded.
+    profile_window_s: float = 30.0
+    # lookback window for the continuous pipeline profiler's per-stage
+    # shares / binding stage (the "profile" snapshot section)
+
+    # -- adaptive pipeline controller (runtime/controller.py) ---------------
+    adaptive: bool = False
+    # master switch, STRICTLY off by default: at snapshot ticks an
+    # AdaptiveController hill-climbs async_depth/fetch_group/h2d_depth
+    # (the barrier-safe overlap depths — never semantics-bearing config)
+    # toward higher windowed ingest rate under the p99 bound below.
+    # Changes apply only at drained barriers; output bytes never change.
+    # Forced off under multi-host execution.
+    adaptive_bounds: Optional[dict] = None
+    # {knob: (lo, hi)} per-knob search bounds; None = controller
+    # defaults (runtime/controller.py DEFAULT_BOUNDS). Unknown knob
+    # names are ignored — the knob set is closed.
+    adaptive_cooldown_ticks: int = 2
+    # settle ticks between moves: each probe is judged against a
+    # baseline measured after the previous change took effect
+    adaptive_hysteresis: float = 0.05
+    # a probe is kept only if the objective improved by more than this
+    # fraction — measurement noise can't walk the knobs
+    adaptive_p99_ms: float = 300.0
+    # latency guard (ROADMAP's "sustainable-rate p99 under 300 ms"):
+    # probes that push e2e p99 past this revert; a steady-state breach
+    # steps every depth down one notch
+
     def replace(self, **kw) -> "ObsConfig":
         import dataclasses
 

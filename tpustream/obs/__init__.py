@@ -14,8 +14,17 @@ the TPU-runtime equivalent:
   dispatch, fetch, emit) in a bounded ring buffer, optionally bridged
   to ``jax.profiler.TraceAnnotation`` so device traces line up with the
   host spans.
+* :mod:`tpustream.obs.timeseries` — bounded ``(timestamp, value)``
+  history rings behind every registry instrument with windowed
+  ``rate()``/``delta()``/``mean()``/``quantile()`` (t-digest-style
+  centroid tail for long windows), mergeable across shards.
+* :mod:`tpustream.obs.profiler` — continuous pipeline profiler: drains
+  StepTracer spans into per-stage time series and computes the live
+  binding stage, per-stage shares, and pipeline occupancy (the
+  ``profile`` snapshot section / ``dump --profile``).
 * :mod:`tpustream.obs.snapshot` — point-in-time JSON snapshots, a
-  periodic snapshotter, and the Prometheus text renderer.
+  periodic snapshotter on an absolute deadline grid (tick skew
+  recorded), and the Prometheus text renderer.
 * :mod:`tpustream.obs.latency` — end-to-end latency markers (Flink's
   ``LatencyMarker``): source-stamped probes that ride the data path so
   each operator edge and sink gets a true source→here latency
@@ -64,7 +73,9 @@ from .registry import (  # noqa: F401
     NULL_GAUGE,
     NULL_HISTOGRAM,
 )
+from .timeseries import TimeSeries  # noqa: F401
 from .tracing import NULL_TRACER, StepTracer  # noqa: F401
+from .profiler import PipelineProfiler  # noqa: F401
 from .snapshot import Snapshotter, job_snapshot, write_snapshot  # noqa: F401
 from .latency import LatencyMarker, MarkerStamper, stamp_markers  # noqa: F401
 from .health import AlertRule, HealthEngine, as_rule  # noqa: F401

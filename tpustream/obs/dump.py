@@ -8,12 +8,14 @@ Accepts a single-snapshot ``.json`` (from
 is shown unless ``--index`` picks another. ``--prom`` prints the
 embedded Prometheus exposition text verbatim instead of the table view.
 ``--health`` shows the snapshot's embedded health section (rule levels
-and transitions); ``--rules rules.json`` re-evaluates a rule set
-against the snapshot's series offline — postmortem alert-rule replay
-over any recorded snapshot. ``--selftest`` needs no input at all: it
-pushes a canned registry + hostile labels + alert rules through the
-whole snapshot/exposition/health path and exits nonzero on any
-mismatch (the CI smoke mode).
+and transitions); ``--profile`` shows only the continuous profiler's
+stage-attribution section (binding stage, per-stage shares, occupancy);
+``--rules rules.json`` re-evaluates a rule set against the snapshot's
+series offline — postmortem alert-rule replay over any recorded
+snapshot. ``--selftest`` needs no input at all: it pushes a canned
+registry + hostile labels + alert rules + time-series/profiler
+machinery through the whole snapshot/exposition/health path and exits
+nonzero on any mismatch (the CI smoke mode).
 
 This module deliberately imports nothing beyond the stdlib — no jax, no
 ``tpustream.runtime`` — so ``render``/``main`` are importable and
@@ -99,6 +101,10 @@ def render(snap: dict) -> str:
                 f"{_fmt_val(v['p50']):>10} {_fmt_val(v['p90']):>10} "
                 f"{_fmt_val(v['p99']):>10}  {_fmt_labels(s['labels'])}"
             )
+    prof = snap.get("profile")
+    if prof:
+        out.append("")
+        out.append(render_profile(prof).rstrip("\n"))
     health = snap.get("health")
     if health:
         out.append("")
@@ -152,6 +158,221 @@ def render_health(health: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def render_profile(prof: dict) -> str:
+    """Render a snapshot's profile section (see obs/profiler.py)."""
+    binding = prof.get("binding_stage") or "-"
+    share = float(prof.get("binding_share", 0.0))
+    out = [
+        f"profile: binding={binding} share={share * 100:.1f}% "
+        f"occupancy={_fmt_val(prof.get('occupancy', 0.0))} "
+        f"batch_wall={_fmt_val(prof.get('batch_wall_ms', 0.0))}ms "
+        f"window={_fmt_val(prof.get('window_s', 0.0))}s"
+    ]
+    stages = prof.get("stages", {})
+    if stages:
+        out.append(
+            f"  {'STAGE':<10} {'N':>6} {'TOTAL_MS':>12} {'MEAN_MS':>10} "
+            f"{'P50_MS':>10} {'P99_MS':>10} {'SHARE':>8}"
+        )
+        order = prof.get("stage_kinds") or sorted(stages)
+        for k in order:
+            s = stages.get(k)
+            if s is None:
+                continue
+            out.append(
+                f"  {k:<10} {s['n']:>6} {_fmt_val(s['total_ms']):>12} "
+                f"{_fmt_val(s['mean_ms']):>10} {_fmt_val(s['p50_ms']):>10} "
+                f"{_fmt_val(s['p99_ms']):>10} {s['share'] * 100:>7.1f}%"
+            )
+    dropped = prof.get("spans_dropped", 0)
+    if dropped:
+        out.append(f"  (spans dropped before attribution: {dropped})")
+    return "\n".join(out) + "\n"
+
+
+class _FakeClock:
+    """Deterministic injectable clock for the selftest's ticks."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _selftest_timeseries() -> list:
+    """Checks for the per-series history machinery: windowed queries,
+    explicit timestamps in both expositions, shard merging, reservoir
+    bounds, and the snapshotter's absolute deadline grid."""
+    from .registry import Histogram, MetricsRegistry
+    from .snapshot import Snapshotter
+    from .timeseries import TimeSeries
+
+    checks = []
+    clock = _FakeClock(0.0)
+    reg = MetricsRegistry()
+    reg.now = clock
+    reg._epoch_wall = 0.0
+    reg._epoch_perf = 0.0
+    g = reg.group(job="ts")
+    c = g.counter("rows")
+    for i in range(1, 11):
+        clock.t = float(i)
+        c.inc(100)
+    checks.append(("counter rate over the window is exact",
+                   abs(c.history.rate(5.0) - 100.0) < 1e-9))
+    checks.append(("counter delta over the window is exact",
+                   abs(c.history.delta(5.0) - 500.0) < 1e-9))
+    snap = reg.snapshot()
+    row = next(s for s in snap["series"] if s["name"] == "rows")
+    checks.append(("snapshot series carry explicit ts_ms",
+                   row.get("ts_ms") == 10_000))
+    checks.append(("snapshot counters carry windowed rate_per_s",
+                   abs(row.get("rate_per_s", 0.0) - 100.0) < 1e-6))
+    checks.append(("prometheus lines carry the sample timestamp",
+                   'rows{job="ts"} 1000 10000' in reg.to_prometheus_text()))
+    clock.t = 20.0
+    hist = g.histogram("lat_ms")
+    for v in range(1, 101):
+        hist.observe(float(v))
+    checks.append(("histogram history quantile matches the exact percentile",
+                   abs(hist.history.quantile(0.5) - 50.5) < 1e-9))
+    checks.append(("histogram lines share the series timestamp",
+                   'lat_ms_count{job="ts"} 100 20000'
+                   in reg.to_prometheus_text()))
+
+    # eviction folds into centroids: the long-window mean stays EXACT
+    # (centroids preserve sum/weight) even after the raw ring turned over
+    ts = TimeSeries(capacity=64, kind="sample", digest=16)
+    for i in range(1000):
+        ts.record(i * 0.01, float(i % 100))
+    checks.append(("digest keeps the long-window mean exact",
+                   abs(ts.mean() - 49.5) < 1e-6))
+    checks.append(("digest bounds retained points",
+                   len(ts) <= 64 and ts.total_samples == 1000))
+
+    # shard merge, cumulative: two shards on one timeline; the merged
+    # step function's windowed rate equals the sum of the shard rates
+    a = TimeSeries(capacity=128, kind="cumulative")
+    b = TimeSeries(capacity=128, kind="cumulative")
+    for i in range(1, 11):
+        a.record(float(i), 60.0 * i)
+        b.record(float(i), 40.0 * i)
+    m = TimeSeries(capacity=256, kind="cumulative")
+    m.merge_from(a)
+    m.merge_from(b)
+    checks.append(("merged cumulative rate equals the sum of shard rates",
+                   abs(m.rate(5.0) - 100.0) < 1e-9))
+    # shard merge, samples: evens + odds == the combined series
+    s1 = TimeSeries(capacity=128, kind="sample")
+    s2 = TimeSeries(capacity=128, kind="sample")
+    for v in range(1, 101):
+        (s1 if v % 2 == 0 else s2).record(float(v), float(v))
+    s1.merge_from(s2)
+    checks.append(("merged sample quantile equals the combined series",
+                   abs(s1.quantile(0.5) - 50.5) < 1e-9))
+
+    # histogram reservoir (satellite): retention bounded, totals exact
+    h = Histogram("reservoir_check", {}, reservoir=128)
+    for v in range(1, 10_001):
+        h.observe(float(v))
+    checks.append(("histogram reservoir bounds retention",
+                   len(h.samples) == 128))
+    checks.append(("histogram count/sum stay exact past the reservoir",
+                   h.count == 10_000 and h.sum == 50_005_000.0))
+    checks.append(("reservoir subsample stays representative",
+                   abs(h.percentile(50) - 5000.0) < 1500.0))
+
+    # snapshotter deadline grid (satellite): a slow tick records skew
+    # but does NOT shift the cadence, and a stall never burst-fires
+    clk = _FakeClock(0.0)
+    reg2 = MetricsRegistry()
+    snapper = Snapshotter(reg2, interval_s=1.0, meta={"job": "ts"},
+                          clock=clk)
+    clk.t = 0.5
+    none_early = snapper.maybe_snapshot() is None
+    clk.t = 1.05
+    s_a = snapper.maybe_snapshot()
+    clk.t = 2.60  # slow tick: 600 ms late
+    s_b = snapper.maybe_snapshot()
+    clk.t = 3.01  # old drift logic would wait until 3.60
+    s_c = snapper.maybe_snapshot()
+    clk.t = 8.70  # long stall: exactly ONE catch-up snapshot
+    s_d = snapper.maybe_snapshot()
+    clk.t = 8.80
+    none_after = snapper.maybe_snapshot() is None
+    checks.append(("snapshotter ticks on the absolute deadline grid",
+                   none_early and s_a is not None and s_b is not None))
+    checks.append(("slow tick does not shift the cadence",
+                   s_c is not None))
+    checks.append(("a stall fires one catch-up tick, not a burst",
+                   s_d is not None and none_after))
+    skews = reg2.find("snapshotter_tick_skew_ms", {"job": "ts"})
+    checks.append(("tick skew is recorded",
+                   skews is not None and skews.count == 4
+                   and abs(skews.samples[0] - 50.0) < 1e-6
+                   and abs(skews.samples[1] - 600.0) < 1e-6))
+    checks.append(("tick skew lands in the snapshot meta",
+                   abs(s_b["meta"]["tick_skew_ms"] - 600.0) < 1e-6))
+    return checks
+
+
+def _selftest_profile() -> list:
+    """Checks for the continuous profiler: crafted spans through a real
+    StepTracer, windowed attribution, gauges, snapshot embedding, and
+    the render paths."""
+    from .profiler import PipelineProfiler
+    from .registry import MetricsRegistry
+    from .snapshot import Snapshotter
+    from .tracing import StepTracer
+
+    checks = []
+    tr = StepTracer(capacity=64)
+    tr._epoch = 0.0  # absolute-time spans for determinism
+    for i in range(3):
+        t = 1.0 + i
+        tr._record("parse", i, "src", t, 0.005)
+        tr._record("dispatch", i, "window", t + 0.01, 0.010)
+        tr._record("fetch", i, "window", t + 0.02, 0.030)
+    reg = MetricsRegistry()
+    pclk = _FakeClock(4.0)
+    prof = PipelineProfiler(tr, reg.group(job="p"), window_s=60.0,
+                            clock=pclk)
+    p = prof.profile()
+    share_sum = sum(s["share"] for s in p["stages"].values())
+    checks.append(("profile names the binding stage",
+                   p["binding_stage"] == "fetch"))
+    checks.append(("profile shares sum to one",
+                   abs(share_sum - 1.0) < 1e-6))
+    checks.append(("binding share matches the span totals",
+                   abs(p["binding_share"] - 90.0 / 135.0) < 1e-6))
+    checks.append(("profile counts every span per stage",
+                   p["stages"]["fetch"]["n"] == 3
+                   and p["stages"]["parse"]["n"] == 3))
+    prom = reg.to_prometheus_text()
+    checks.append(("profile gauges land in the exposition",
+                   'profile_binding_stage{job="p"} 4' in prom
+                   and 'stage="fetch"' in prom))
+    snapper = Snapshotter(reg, tracer=tr, interval_s=1.0,
+                          meta={"job": "p"}, clock=_FakeClock(5.0))
+    snapper.profiler = prof
+    snap = snapper.take()
+    checks.append(("profile lands in the snapshot",
+                   snap.get("profile", {}).get("binding_stage") == "fetch"))
+    text = render(snap)
+    checks.append(("render shows the profile section",
+                   "profile: binding=fetch" in text))
+    checks.append(("profile render carries the stage table",
+                   "STAGE" in render_profile(p)
+                   and "fetch" in render_profile(p)))
+    pclk.t = 5.0
+    tr._record("fetch", 3, "window", 4.1, 0.030)
+    p2 = prof.profile()
+    checks.append(("profiler drains spans incrementally",
+                   p2["stages"]["fetch"]["n"] == 4))
+    return checks
+
+
 def _selftest() -> int:
     """CI smoke mode: a canned registry (hostile labels included) runs
     through snapshot -> render -> Prometheus exposition -> health
@@ -197,6 +418,12 @@ def _selftest() -> int:
     g.counter("compaction_spills").inc(1)
     g.gauge("compaction_ratio").set(0.015625)
     g.gauge("pipeline_occupancy").set_fn(lambda: 3)
+    # controller series surface (runtime/controller.py mints these; the
+    # algorithm itself is exercised in tests/test_obs_timeseries.py —
+    # importing it here would pull the tpustream package root)
+    g.gauge("controller_async_depth").set(3)
+    g.gauge("controller_objective_rows_per_s").set(123456.0)
+    g.counter("controller_decisions_total").inc(4)
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -316,7 +543,14 @@ def _selftest() -> int:
          and dump["events"][-1]["operator"] == "window"),
         ("flight dump serializes", bool(_json.dumps(dump))),
         ("snapshot serializes", bool(_json.dumps(snap))),
+        ("prometheus carries the controller series",
+         'controller_async_depth{job="selftest"} 3' in prom
+         and 'controller_decisions_total{job="selftest"} 4' in prom
+         and 'controller_objective_rows_per_s{job="selftest"} 123456'
+         in prom),
     ]
+    checks.extend(_selftest_timeseries())
+    checks.extend(_selftest_profile())
     failed = [name for name, ok in checks if not ok]
     for name, ok in checks:
         sys.stdout.write(f"{'ok' if ok else 'FAIL'}: {name}\n")
@@ -354,6 +588,12 @@ def main(argv=None) -> int:
         help="show only the snapshot's health section",
     )
     ap.add_argument(
+        "--profile",
+        action="store_true",
+        help="show only the continuous profiler's stage attribution "
+        "(binding stage, per-stage shares, occupancy)",
+    )
+    ap.add_argument(
         "--rules",
         help="JSON file with a list of alert-rule dicts to (re-)evaluate "
         "against the snapshot's series",
@@ -381,6 +621,15 @@ def main(argv=None) -> int:
         )
     if args.prom:
         sys.stdout.write(snap.get("prometheus", ""))
+    elif args.profile:
+        prof = snap.get("profile")
+        if not prof:
+            sys.stdout.write(
+                "no profile section in this snapshot (requires "
+                "ObsConfig.enabled with trace on)\n"
+            )
+            return 1
+        sys.stdout.write(render_profile(prof))
     elif args.health:
         health = snap.get("health")
         if not health:

@@ -13,12 +13,28 @@ or last-write-wins gauges).
 Instruments update per batch/step — the registry is never consulted on
 a per-record path. The ``NULL_*`` singletons are the disabled twins:
 same method surface, no state, no work.
+
+Every registry-minted instrument also carries a bounded
+:class:`~tpustream.obs.timeseries.TimeSeries` history (``inst.history``)
+recorded on writes, so windowed ``rate()``/``delta()``/``mean()``/
+``quantile()`` are available in-process — the profiler and the adaptive
+controller read these. Each series remembers its last-write timestamp
+(``_last_t``, registry clock), which the snapshot (``ts_ms``) and
+Prometheus exposition (trailing millisecond timestamp) surface so a
+scrape-side consumer can compute rates too. Pulled (``set_fn``) gauges
+record history and refresh their timestamp only on explicit ``set()``
+writes — a render must never mutate timestamps, or two back-to-back
+scrapes of an idle job would disagree.
 """
 
 from __future__ import annotations
 
 import math
+import random
+import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from .timeseries import TimeSeries
 
 PROM_PREFIX = "tpustream_"
 
@@ -27,6 +43,22 @@ LabelKey = Tuple[Tuple[str, str], ...]
 
 def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _touch(inst, v) -> None:
+    """Stamp a write on a registry-minted instrument: refresh its
+    last-write time and append to its bounded history ring."""
+    reg = inst._registry
+    if reg is None:
+        return
+    t = reg.now()
+    inst._last_t = t
+    h = inst.history
+    if h is not None:
+        try:
+            h.record(t, float(v))
+        except (TypeError, ValueError):
+            pass  # non-numeric gauge payloads keep last-write only
 
 
 class Counter:
@@ -38,18 +70,24 @@ class Counter:
     """
 
     kind = "counter"
-    __slots__ = ("name", "labels", "_value")
+    __slots__ = ("name", "labels", "_value", "_registry", "history",
+                 "_last_t")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
         self.labels = dict(labels)
         self._value = 0
+        self._registry: Optional["MetricsRegistry"] = None
+        self.history: Optional[TimeSeries] = None
+        self._last_t: Optional[float] = None
 
     def inc(self, n: int = 1) -> None:
         self._value += n
+        _touch(self, self._value)
 
     def set_total(self, v: int) -> None:
         self._value = int(v)
+        _touch(self, self._value)
 
     @property
     def value(self) -> int:
@@ -72,7 +110,8 @@ class Gauge:
     """
 
     kind = "gauge"
-    __slots__ = ("name", "labels", "_value", "_fn", "_registry", "_errored")
+    __slots__ = ("name", "labels", "_value", "_fn", "_registry", "_errored",
+                 "history", "_last_t")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
@@ -81,9 +120,12 @@ class Gauge:
         self._fn: Optional[Callable[[], Optional[float]]] = None
         self._registry: Optional["MetricsRegistry"] = None
         self._errored = False
+        self.history: Optional[TimeSeries] = None
+        self._last_t: Optional[float] = None
 
     def set(self, v) -> None:
         self._value = v
+        _touch(self, v)
 
     def set_fn(self, fn: Callable[[], Optional[float]]) -> None:
         self._fn = fn
@@ -123,31 +165,59 @@ class Gauge:
 class Histogram:
     """Sample-holding histogram with exact running count/sum.
 
-    ``max_samples = 0`` keeps every observation (exact percentiles — the
-    per-job latency/time series the summary facade needs stay exact);
-    ``> 0`` keeps the most recent ``max_samples`` observations in a ring
-    (bounded memory for long-running per-operator series) while
-    ``count``/``sum`` stay exact.
+    ``max_samples = 0`` keeps observations without a recency bound, but
+    raw retention is capped by ``reservoir``: past that many samples the
+    ring becomes a uniform random subsample of the full stream (Vitter's
+    Algorithm R, deterministic per series name) — percentiles stay
+    representative of the whole run while memory stays bounded over a
+    long-running job. ``reservoir = 0`` restores truly unbounded
+    retention. ``max_samples > 0`` keeps the most recent ``max_samples``
+    observations in a recency ring instead (per-operator series that
+    should reflect *current* behavior). ``count``/``sum`` are exact in
+    every mode.
     """
 
     kind = "histogram"
-    __slots__ = ("name", "labels", "max_samples", "_ring", "_pos", "count", "sum")
+    __slots__ = ("name", "labels", "max_samples", "reservoir", "_ring",
+                 "_pos", "count", "sum", "_rng", "_registry", "history",
+                 "_last_t")
 
-    def __init__(self, name: str, labels: Dict[str, str], max_samples: int = 0):
+    def __init__(self, name: str, labels: Dict[str, str],
+                 max_samples: int = 0, reservoir: int = 4096):
         self.name = name
         self.labels = dict(labels)
         self.max_samples = int(max_samples)
+        self.reservoir = max(0, int(reservoir))
         self._ring: List[float] = []
         self._pos = 0  # next overwrite slot when the ring is full
         self.count = 0
         self.sum = 0.0
+        self._rng: Optional[random.Random] = None
+        self._registry: Optional["MetricsRegistry"] = None
+        self.history: Optional[TimeSeries] = None
+        self._last_t: Optional[float] = None
 
     def observe(self, v: float) -> None:
         self.count += 1
         self.sum += v
-        if self.max_samples and len(self._ring) >= self.max_samples:
-            self._ring[self._pos] = v
-            self._pos = (self._pos + 1) % self.max_samples
+        self._retain(v)
+        _touch(self, v)
+
+    def _retain(self, v: float) -> None:
+        if self.max_samples:
+            if len(self._ring) >= self.max_samples:
+                self._ring[self._pos] = v
+                self._pos = (self._pos + 1) % self.max_samples
+            else:
+                self._ring.append(v)
+        elif self.reservoir and len(self._ring) >= self.reservoir:
+            if self._rng is None:
+                # seeded by series name: a replayed run keeps the same
+                # retained subsample, so goldens stay stable
+                self._rng = random.Random(self.name)
+            j = self._rng.randrange(self.count)
+            if j < self.reservoir:
+                self._ring[j] = v
         else:
             self._ring.append(v)
 
@@ -190,11 +260,7 @@ class Histogram:
         self.count += other.count
         self.sum += other.sum
         for v in other.samples:
-            if self.max_samples and len(self._ring) >= self.max_samples:
-                self._ring[self._pos] = v
-                self._pos = (self._pos + 1) % self.max_samples
-            else:
-                self._ring.append(v)
+            self._retain(v)
 
 
 class _NullInstrument:
@@ -228,12 +294,20 @@ class _NullInstrument:
     value = 0
     count = 0
     sum = 0.0
+    history = None
+    _last_t = None
 
     @property
     def samples(self) -> list:
         return []
 
     def percentile(self, q: float) -> float:
+        return 0.0
+
+    def rate(self, window_s=None, now=None) -> float:
+        return 0.0
+
+    def quantile(self, q, window_s=None, now=None) -> float:
         return 0.0
 
 
@@ -262,10 +336,12 @@ class MetricGroup:
     def gauge(self, name: str) -> Gauge:
         return self.registry._series(Gauge, name, self.labels)
 
-    def histogram(self, name: str, max_samples: int = 0) -> Histogram:
-        return self.registry._series(
-            Histogram, name, self.labels, max_samples=max_samples
-        )
+    def histogram(self, name: str, max_samples: int = 0,
+                  reservoir: Optional[int] = None) -> Histogram:
+        kw = {"max_samples": max_samples}
+        if reservoir is not None:
+            kw["reservoir"] = reservoir
+        return self.registry._series(Histogram, name, self.labels, **kw)
 
 
 class MetricsRegistry:
@@ -276,17 +352,51 @@ class MetricsRegistry:
         # optional FlightRecorder (installed by JobObs) so instrument
         # error paths can leave a breadcrumb without an import cycle
         self.flight = None
+        # clock + epoch pair: ``now()`` is the write-timestamp source
+        # (monotonic; injectable in tests), the epoch pair maps its
+        # readings onto wall-clock ms for exposition
+        self.now: Callable[[], float] = time.perf_counter
+        self._epoch_perf = time.perf_counter()
+        self._epoch_wall = time.time()
+        # per-instrument history knobs, applied at mint time (JobObs
+        # overwrites these from ObsConfig before any series exists)
+        self.history_capacity = 512
+        self.history_digest = 64
+        self.default_reservoir = 4096
+        self.rate_window_s = 60.0  # window for snapshot()'s rate_per_s
+
+    def wall_ms(self, t: Optional[float]) -> Optional[int]:
+        """Map a registry-clock reading to integer wall-clock ms."""
+        if t is None:
+            return None
+        return int(round((self._epoch_wall + (t - self._epoch_perf)) * 1000.0))
 
     def group(self, **labels) -> MetricGroup:
         return MetricGroup(self, {k: str(v) for k, v in labels.items()})
+
+    def find(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """The instrument for exactly ``(name, labels)``, or None."""
+        return self._by_key.get((name, _label_key(labels or {})))
 
     def _series(self, cls, name: str, labels: Dict[str, str], **kw):
         key = (name, _label_key(labels))
         inst = self._by_key.get(key)
         if inst is None:
+            if cls is Histogram and "reservoir" not in kw:
+                kw["reservoir"] = self.default_reservoir
             inst = cls(name, labels, **kw)
-            if cls is Gauge:
-                inst._registry = self
+            inst._registry = self
+            inst._last_t = self.now()
+            if self.history_capacity > 0:
+                inst.history = TimeSeries(
+                    self.history_capacity,
+                    kind="cumulative" if cls is Counter else "sample",
+                    digest=self.history_digest,
+                )
+                if cls is Counter:
+                    # anchor the step function at zero so the very first
+                    # inc() already yields a two-point windowed rate
+                    inst.history.record(inst._last_t, 0.0)
             self._by_key[key] = inst
         elif not isinstance(inst, cls):
             raise TypeError(
@@ -309,42 +419,69 @@ class MetricsRegistry:
         ``other`` are minted here with the same name/labels/kind — the
         multi-shard aggregation path: per-shard registries (distinct
         ``shard`` labels, so nothing collides) merge into one scrape
-        view, and health rules evaluate over the merged series."""
+        view, and health rules evaluate over the merged series. Series
+        histories merge too (kind-aware, see TimeSeries.merge_from), and
+        the merged timestamp is the newest of the two — totals fold with
+        direct writes, not inc()/set(), so merging never fabricates
+        present-time history samples."""
         for inst in other.series():
             if inst.kind == "counter":
                 mine = self._series(Counter, inst.name, inst.labels)
-                mine.inc(inst.value)
+                mine._value += inst.value
             elif inst.kind == "gauge":
                 mine = self._series(Gauge, inst.name, inst.labels)
-                mine.set(inst.value)
+                mine._value = inst.value
             elif inst.kind == "histogram":
                 mine = self._series(
                     Histogram, inst.name, inst.labels,
                     max_samples=inst.max_samples,
+                    reservoir=getattr(inst, "reservoir", 4096),
                 )
                 mine.merge_from(inst)
+            else:
+                continue
+            oh = getattr(inst, "history", None)
+            if oh is not None and mine.history is not None:
+                mine.history.merge_from(oh)
+            ot = getattr(inst, "_last_t", None)
+            if ot is not None and (mine._last_t is None or ot > mine._last_t):
+                mine._last_t = ot
         return self
 
     # -- exposition --------------------------------------------------------
     def snapshot(self) -> dict:
-        """JSON-serializable point-in-time view of every series."""
+        """JSON-serializable point-in-time view of every series.
+
+        Each entry carries ``ts_ms`` (wall-clock ms of the last write —
+        the explicit sample timestamp a JSON consumer needs to compute
+        scrape-side rates) and, for counters with history, ``rate_per_s``
+        over the registry's ``rate_window_s``."""
         out = []
         for inst in self.series():
-            out.append(
-                {
-                    "name": inst.name,
-                    "type": inst.kind,
-                    "labels": dict(inst.labels),
-                    "value": inst.snapshot_value(),
-                }
-            )
+            entry = {
+                "name": inst.name,
+                "type": inst.kind,
+                "labels": dict(inst.labels),
+                "value": inst.snapshot_value(),
+            }
+            ts = self.wall_ms(getattr(inst, "_last_t", None))
+            if ts is not None:
+                entry["ts_ms"] = ts
+            h = getattr(inst, "history", None)
+            if inst.kind == "counter" and h is not None:
+                entry["rate_per_s"] = round(h.rate(self.rate_window_s), 9)
+            out.append(entry)
         return {"series": out}
 
     def to_prometheus_text(self) -> str:
         """Prometheus text exposition (0.0.4). Counters/gauges render
         directly; histograms render as summaries (quantile series plus
         ``_sum``/``_count``), the convention Flink's Prometheus reporter
-        uses for its latency histograms."""
+        uses for its latency histograms. Every sample line carries the
+        series' explicit last-write timestamp in ms (the text-format
+        optional trailing field), so a scraper computes correct rates
+        even when the scrape interval and the job's write cadence
+        disagree; all of one histogram's lines share its timestamp."""
         by_name: Dict[str, List[object]] = {}
         for inst in self.series():
             by_name.setdefault(inst.name, []).append(inst)
@@ -356,18 +493,28 @@ class MetricsRegistry:
             if kind == "histogram":
                 lines.append(f"# TYPE {prom} summary")
                 for h in insts:
+                    sfx = _prom_ts(self.wall_ms(getattr(h, "_last_t", None)))
                     for q, qv in (("0.5", 50), ("0.9", 90), ("0.99", 99)):
                         lbl = _prom_labels(h.labels, quantile=q)
-                        lines.append(f"{prom}{lbl} {_prom_num(h.percentile(qv))}")
+                        lines.append(
+                            f"{prom}{lbl} {_prom_num(h.percentile(qv))}{sfx}"
+                        )
                     lbl = _prom_labels(h.labels)
-                    lines.append(f"{prom}_sum{lbl} {_prom_num(h.sum)}")
-                    lines.append(f"{prom}_count{lbl} {h.count}")
+                    lines.append(f"{prom}_sum{lbl} {_prom_num(h.sum)}{sfx}")
+                    lines.append(f"{prom}_count{lbl} {h.count}{sfx}")
             else:
                 lines.append(f"# TYPE {prom} {kind}")
                 for inst in insts:
                     lbl = _prom_labels(inst.labels)
-                    lines.append(f"{prom}{lbl} {_prom_num(inst.snapshot_value())}")
+                    sfx = _prom_ts(self.wall_ms(getattr(inst, "_last_t", None)))
+                    lines.append(
+                        f"{prom}{lbl} {_prom_num(inst.snapshot_value())}{sfx}"
+                    )
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prom_ts(ts_ms: Optional[int]) -> str:
+    return f" {ts_ms}" if ts_ms is not None else ""
 
 
 def _prom_num(v) -> str:

@@ -92,6 +92,14 @@ class JobObs:
         bridge = getattr(cfg, "profiler_bridge", False)
         self.hist_samples = getattr(cfg, "step_histogram_samples", 8192)
         self.registry = registry or MetricsRegistry()
+        # history/retention knobs must land before any series is minted
+        # (they are applied at mint time); re-applying to a shared
+        # registry across restart attempts is idempotent
+        self.registry.history_capacity = int(getattr(cfg, "timeseries_ring", 512))
+        self.registry.history_digest = int(getattr(cfg, "timeseries_digest", 64))
+        self.registry.default_reservoir = int(
+            getattr(cfg, "histogram_reservoir", 4096)
+        )
         self.job_name = str(job_name)
         self.group = self.registry.group(job=self.job_name)
         self.tracer = StepTracer(ring, bridge) if trace else NULL_TRACER
@@ -102,6 +110,19 @@ class JobObs:
             jsonl_path=getattr(cfg, "snapshot_path", "") or None,
             meta={"job": self.job_name},
         )
+        # continuous per-stage profiler (obs/profiler.py) rides the
+        # tracer; snapshots embed its windowed attribution as "profile"
+        self.profiler = None
+        if trace:
+            from .profiler import PipelineProfiler
+
+            self.profiler = PipelineProfiler(
+                self.tracer,
+                self.group,
+                window_s=getattr(cfg, "profile_window_s", 30.0),
+                ring=self.registry.history_capacity or 512,
+            )
+        self.snapshotter.profiler = self.profiler
         self._op_names: dict = {}
 
         # crash-dump flight recorder (obs/flightrecorder.py); a
@@ -178,7 +199,11 @@ class JobObs:
     def snapshot(self, meta: Optional[dict] = None) -> dict:
         m = {"job": self.job_name}
         m.update(meta or {})
+        # profile first so its gauges land in this snapshot's series
+        prof = self.profiler.profile() if self.profiler is not None else None
         snap = job_snapshot(self.registry, self.tracer, meta=m)
+        if prof is not None:
+            snap["profile"] = prof
         if self.health is not None:
             snap["health"] = self.health.state()
         return snap
@@ -295,6 +320,7 @@ class _NullJobObs:
     tracer = NULL_TRACER
     job_name = ""
     snapshotter = None
+    profiler = None
     flight = NULL_FLIGHT
     health = None
     flight_dump_path = ""

@@ -7,7 +7,10 @@
 * ``GET /metrics``       — Prometheus text 0.0.4 (the registry renderer)
 * ``GET /healthz``       — HealthEngine levels as JSON; HTTP 503 while
   any rule is CRIT, so a liveness probe needs no body parsing
-* ``GET /snapshot.json`` — the full job snapshot (series + trace + health)
+* ``GET /snapshot.json`` — the full job snapshot (series + trace + health
+  + the continuous profiler's ``profile`` section)
+* ``GET /profile.json``  — just the profiler's windowed stage
+  attribution (binding stage, shares, occupancy), cheap to poll
 
 Everything else is 404; non-GET methods are 405. The server is pure
 stdlib (no deps), started/stopped by ``execute_job`` alongside the
@@ -101,6 +104,18 @@ class MetricsServer:
             if path == "/snapshot.json":
                 body = json.dumps(
                     self._provider.snapshot(), default=str
+                ).encode("utf-8")
+                return 200, "application/json", body
+            if path == "/profile.json":
+                profiler = getattr(self._provider, "profiler", None)
+                if profiler is None:
+                    return (
+                        404,
+                        "application/json",
+                        b'{"error": "no profiler (tracing disabled)"}',
+                    )
+                body = json.dumps(
+                    profiler.profile(), default=str
                 ).encode("utf-8")
                 return 200, "application/json", body
             return (

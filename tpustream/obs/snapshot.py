@@ -44,12 +44,18 @@ def write_snapshot(path: str, snap: dict) -> str:
 class Snapshotter:
     """Periodic snapshot taker driven from the executor's batch loop.
 
-    ``maybe_snapshot()`` is the per-batch hook: it no-ops until
-    ``interval_s`` has elapsed since the last capture, then records a
-    snapshot. Retains at most ``max_snapshots`` (oldest dropped); when
-    ``jsonl_path`` is set every snapshot is also appended there, one
-    JSON object per line, so long jobs keep a full on-disk time series
-    regardless of the in-memory bound.
+    ``maybe_snapshot()`` is the per-batch hook. Ticks live on an
+    **absolute monotonic deadline grid** anchored at construction time
+    (deadline *n* is ``t0 + n * interval_s``): a tick fires when the
+    clock passes the next un-fired deadline, and a slow tick (or a long
+    stall) advances past every missed deadline without shifting the grid
+    — cadence never drifts by accumulated lateness, and a stall never
+    burst-fires one snapshot per missed interval. How late each tick
+    fired is recorded in the ``snapshotter_tick_skew_ms`` histogram (and
+    ``meta["tick_skew_ms"]``). Retains at most ``max_snapshots`` (oldest
+    dropped); when ``jsonl_path`` is set every snapshot is also appended
+    there, one JSON object per line, so long jobs keep a full on-disk
+    time series regardless of the in-memory bound.
     """
 
     def __init__(
@@ -60,6 +66,7 @@ class Snapshotter:
         max_snapshots: int = 64,
         jsonl_path: Optional[str] = None,
         meta: Optional[dict] = None,
+        clock=None,
     ):
         self.registry = registry
         self.tracer = tracer
@@ -68,8 +75,13 @@ class Snapshotter:
         self.jsonl_path = jsonl_path
         self.meta = dict(meta or {})
         self.snapshots: List[dict] = []
-        self._last = time.perf_counter()
-        self._t0 = self._last
+        self._clock = clock or time.perf_counter
+        self._t0 = self._clock()
+        self._n = 0  # index of the last fired deadline on the grid
+        self._skew_hist = None
+        # optional PipelineProfiler: when set, every take() embeds its
+        # windowed stage attribution as snap["profile"]
+        self.profiler = None
         # optional HealthEngine: evaluated at every take(), so alert
         # rules tick exactly as often as snapshots (the design point:
         # self-monitoring shares the snapshot cadence, no extra timers)
@@ -83,18 +95,43 @@ class Snapshotter:
     def maybe_snapshot(self) -> Optional[dict]:
         if not self.enabled:
             return None
-        now = time.perf_counter()
-        if now - self._last < self.interval_s:
+        now = self._clock()
+        deadline = self._t0 + (self._n + 1) * self.interval_s
+        if now < deadline:
             return None
-        self._last = now
-        return self.take(at_s=now - self._t0)
+        skew_ms = (now - deadline) * 1000.0
+        self._n = int((now - self._t0) / self.interval_s)
+        self._record_skew(skew_ms)
+        return self.take(at_s=now - self._t0, skew_ms=skew_ms)
 
-    def take(self, at_s: Optional[float] = None) -> dict:
+    def _record_skew(self, skew_ms: float) -> None:
+        if self._skew_hist is None:
+            labels = {}
+            if "job" in self.meta:
+                labels["job"] = self.meta["job"]
+            try:
+                self._skew_hist = self.registry.group(**labels).histogram(
+                    "snapshotter_tick_skew_ms"
+                )
+            except Exception:
+                return
+        self._skew_hist.observe(skew_ms)
+
+    def take(self, at_s: Optional[float] = None,
+             skew_ms: Optional[float] = None) -> dict:
         meta = dict(self.meta)
         if at_s is None:
-            at_s = time.perf_counter() - self._t0
+            at_s = self._clock() - self._t0
         meta["at_s"] = round(at_s, 6)
+        if skew_ms is not None:
+            meta["tick_skew_ms"] = round(skew_ms, 3)
+        # profile BEFORE the registry snapshot: profile() pushes the
+        # binding/occupancy/share gauges, and this snapshot's series
+        # should match its embedded profile section
+        prof = self.profiler.profile() if self.profiler is not None else None
         snap = job_snapshot(self.registry, self.tracer, meta=meta)
+        if prof is not None:
+            snap["profile"] = prof
         if self.health_engine is not None:
             # evaluate AFTER the registry snapshot so rules see exactly
             # the series this snapshot carries

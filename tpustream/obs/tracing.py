@@ -94,6 +94,21 @@ class StepTracer:
             self._ring.append(ev)
         self.total_spans += 1
 
+    def raw_tail(self, n: int) -> List[tuple]:
+        """The newest ``n`` retained raw span tuples
+        ``(kind, step, operator, t_start_rel_s, dur_s)``, oldest first.
+        The continuous profiler's incremental drain — no dict formatting
+        on the consume path. ``t_start_rel_s`` is relative to
+        :attr:`epoch` (registry-clock seconds at tracer construction)."""
+        ordered = self._ring[self._pos:] + self._ring[: self._pos]
+        if n < len(ordered):
+            return ordered[len(ordered) - n:]
+        return ordered
+
+    @property
+    def epoch(self) -> float:
+        return self._epoch
+
     def events(self) -> List[dict]:
         """Spans in arrival order, oldest retained first."""
         ordered = self._ring[self._pos :] + self._ring[: self._pos]
@@ -138,11 +153,15 @@ class _NullTracer:
     enabled = False
     capacity = 0
     total_spans = 0
+    epoch = 0.0
 
     __slots__ = ()
 
     def span(self, kind: str, step: int = -1, operator: str = "") -> _NullSpan:
         return _NULL_SPAN
+
+    def raw_tail(self, n: int) -> list:
+        return []
 
     def events(self) -> list:
         return []

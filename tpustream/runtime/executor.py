@@ -1319,6 +1319,48 @@ class Runner:
             for s in range(0, len(entries), g):
                 self._finish_group(entries[s : s + g])
 
+    def apply_knobs(self, knobs: dict) -> None:
+        """Apply barrier-safe pipeline-depth knobs (async_depth,
+        fetch_group, h2d_depth) at a DRAINED barrier — the adaptive
+        controller's application point, using the same quiesce-then-
+        mutate pattern as rule updates. The caller must have drained the
+        chain: queues are empty here, so the new depths simply take
+        effect on the next feed. Every constructor-forced synchronous
+        mode (multi-host, live-state emissions, max_fires_per_step
+        pacing) stays forced — the controller can ask, but the build-time
+        guards still win, so output bytes never depend on a knob."""
+        kw = {}
+        if "async_depth" in knobs:
+            d = max(1, int(knobs["async_depth"]))
+            if d != self.cfg.async_depth:
+                kw["async_depth"] = d
+            if not self.program.emissions_reference_state:
+                self._max_inflight = max(0, d - 1)
+        if "fetch_group" in knobs:
+            g = max(1, int(knobs["fetch_group"]))
+            if g != self.cfg.fetch_group:
+                kw["fetch_group"] = g  # read live via the property
+        if "h2d_depth" in knobs:
+            d = max(1, int(knobs["h2d_depth"]))
+            if d != self.cfg.h2d_depth:
+                kw["h2d_depth"] = d
+            stage_ok = (
+                not self._multiproc
+                and not self.program.emissions_reference_state
+                and self.cfg.max_fires_per_step is None
+            )
+            self._h2d_ahead = max(0, d - 1) if stage_ok else 0
+            if self._h2d_ahead and self._h2d_sharding is None:
+                mesh = getattr(self.program, "mesh", None)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+
+                    from ..parallel.mesh import AXIS
+
+                    self._h2d_sharding = NamedSharding(mesh, P(AXIS))
+        if kw:
+            self.cfg = self.cfg.replace(**kw)
+
     # -- latency markers (obs/latency.py) ----------------------------------
 
     def accept_markers(self, markers) -> None:
@@ -2337,6 +2379,21 @@ def _execute_job(env, sink_nodes) -> JobResult:
     else:
         metrics = Metrics()
         job_obs = metrics.job_obs  # the null twin
+    # adaptive pipeline controller (runtime/controller.py): opt-in
+    # closed-loop tuning of the barrier-safe overlap depths at snapshot
+    # ticks. Requires live obs (it reads the registry's series history)
+    # and single-host execution — locally-timed decisions would diverge
+    # across processes and desynchronize the collective schedule.
+    controller = None
+    if job_obs.enabled and getattr(cfg.obs, "adaptive", False):
+        if jax.process_count() == 1:
+            from .controller import AdaptiveController
+
+            controller = AdaptiveController(cfg, job_obs)
+        else:
+            job_obs.flight.record(
+                "controller_disabled", reason="multiprocess"
+            )
     # dead-letter quarantine output (StreamConfig.dead_letter); lives on
     # the env so it survives restarts and the user reads it after execute
     dead_letters = getattr(env, "dead_letters", None)
@@ -2730,7 +2787,15 @@ def _execute_job(env, sink_nodes) -> JobResult:
                     jump_ms=wm_now - wm_prev,
                 )
             wm_prev = wm_now
-        job_obs.maybe_snapshot()
+        tick_snap = job_obs.maybe_snapshot()
+        if controller is not None and tick_snap is not None and runner is not None:
+            knobs = controller.on_tick()
+            if knobs:
+                # quiesce first: depth changes land between fully
+                # retired steps, so output bytes never depend on them
+                runner.drain_chain(proc_now)
+                for r in runner.chain():
+                    r.apply_knobs(knobs)
         if sb.proc_ts.size:
             proc_now = max(proc_now, int(sb.proc_ts.max()))
         if sb.advance_proc_to is not None:
