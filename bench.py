@@ -465,6 +465,87 @@ def dynamic_rules_probe():
     )
 
 
+def multitenancy_probe(tenant_counts=(1, 16, 64, 256),
+                       records_per_tenant=64, batch_size=256):
+    """Phase T: multi-tenant multiplexing sweep (docs/multitenancy.md).
+    Runs the chapter-6 tenant fleet at 1/16/64/256 tenants — each fleet
+    is ONE compiled program with [T] rule vectors — and reports
+    throughput and per-batch cost vs tenant count, plus one hot
+    per-tenant rule write mid-stream per fleet: its propagation latency
+    series and the zero-recompile proof (``operator_recompile_cause``
+    must show no ``config_change`` builds at any fleet size)."""
+    import time as _time
+
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs import chapter6_tenant_fleet as c6
+
+    sweep = []
+    series = []
+    for T in tenant_counts:
+        thresholds = {f"t{i:03d}": 80.0 + (i % 20) for i in range(T)}
+        srv = c6.make_fleet(
+            thresholds,
+            tenant_capacity=T,
+            config=StreamConfig(
+                batch_size=batch_size, obs=ObsConfig(enabled=True)
+            ),
+        )
+        lines = {
+            t: c6.tenant_lines(t, records_per_tenant) for t in thresholds
+        }
+        half = records_per_tenant // 2
+        for t in thresholds:
+            srv.ingest(t, lines[t][:half])
+        # a hot per-tenant rule-row write mid-stream: fleet shape intact
+        srv.update_tenant_rules("t000", {"threshold": 83.0})
+        for t in thresholds:
+            srv.ingest(t, lines[t][half:])
+        t0 = _time.perf_counter()
+        srv.run(f"fleet-{T}")
+        wall_s = _time.perf_counter() - t0
+        total = T * records_per_tenant
+        n_batches = max(1, -(-total // batch_size))
+        series = srv.env.metrics.obs_snapshot()["metrics"]["series"]
+        config_change_builds = sum(
+            s["value"]
+            for s in series
+            if s["name"] == "operator_recompile_cause"
+            and s["labels"].get("cause") == "config_change"
+        )
+        probe = "t000"
+        want = c6.expected(
+            probe, lines[probe], thresholds[probe],
+            [(0, thresholds[probe]), (half, 83.0)],
+        )
+        sweep.append(dict(
+            tenants=T,
+            events_per_s=round(total / wall_s) if wall_s else None,
+            ms_per_batch=round(wall_s * 1000.0 / n_batches, 3),
+            config_change_recompiles=config_change_builds,
+            updated_tenant_matches_oracle=(
+                [tuple(x) for x in srv.output(probe)]
+                == [tuple(x) for x in want]
+            ),
+        ))
+
+    def pick(name, field=None):  # from the largest fleet's registry
+        for s in series:
+            if s["name"].endswith(name):
+                return s["value"][field] if field else s["value"]
+        return None
+
+    return dict(
+        sweep=sweep,
+        propagation_ms_p50=pick("rule_update_propagation_ms", "p50"),
+        all_outputs_match=all(
+            e["updated_tenant_matches_oracle"] for e in sweep
+        ),
+        zero_config_change_recompiles=all(
+            e["config_change_recompiles"] == 0 for e in sweep
+        ),
+    )
+
+
 def sustainable_rate(run_paced, r0, label, rtt_ms):
     """Rate -> p99 curve with stage attribution (VERDICT r4 next #1),
     walking a descending rate ladder from the flood throughput ``r0``.
@@ -1976,6 +2057,22 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase U skipped: {e}")
 
+    # ---- Phase T: multi-tenant multiplexing sweep -----------------------
+    multitenancy = None
+    try:
+        multitenancy = multitenancy_probe()
+        top = multitenancy["sweep"][-1]
+        log(
+            f"phase T: {top['tenants']} tenants through one compiled "
+            f"program at {top['events_per_s']} events/s "
+            f"({top['ms_per_batch']} ms/batch); zero config_change "
+            f"recompiles at every fleet size: "
+            f"{multitenancy['zero_config_change_recompiles']}; outputs "
+            f"match oracle: {multitenancy['all_outputs_match']}"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase T skipped: {e}")
+
     print(
         json.dumps(
             {
@@ -2069,6 +2166,11 @@ def main():
                     # costs — propagation latency and the zero-recompile
                     # proof (docs/dynamic_rules.md)
                     "dynamic_rules": dynamic_rules,
+                    # phase T: N logical jobs multiplexed onto one
+                    # compiled step — throughput and per-batch cost vs
+                    # tenant count, with the per-fleet zero-recompile
+                    # proof (docs/multitenancy.md)
+                    "multitenancy": multitenancy,
                     # and its device-side registries, folded: what XLA
                     # built (count/cause/wall/cost) and what the state
                     # pytree costs in HBM per operator/component

@@ -61,3 +61,19 @@ def test_decompose_controller_pass_tiny_mode(bench):
 def test_measure_h2d_reports_positive_bandwidth(bench):
     mb_s = bench.measure_h2d()
     assert mb_s > 0
+
+
+def test_multitenancy_probe_tiny_mode(bench):
+    """Phase T in tiny mode: two fleet sizes, each through one compiled
+    program with a hot per-tenant rule write — throughput/cost keys
+    present, oracle output intact, zero config_change recompiles."""
+    d = bench.multitenancy_probe(
+        tenant_counts=(1, 4), records_per_tenant=8, batch_size=16
+    )
+    assert [e["tenants"] for e in d["sweep"]] == [1, 4]
+    for e in d["sweep"]:
+        assert e["events_per_s"] > 0 and e["ms_per_batch"] > 0
+        assert e["config_change_recompiles"] == 0
+        assert e["updated_tenant_matches_oracle"]
+    assert d["zero_config_change_recompiles"]
+    assert d["all_outputs_match"]

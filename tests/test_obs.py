@@ -250,11 +250,19 @@ def test_dump_render_and_cli(tmp_path, capsys):
 def test_dump_selftest_smoke(capsys):
     """`python -m tpustream.obs.dump --selftest` is the CI smoke mode:
     canned registry -> snapshot -> render -> Prometheus -> health ->
-    flight dump, every check must hold."""
+    flight dump, every check must hold — and the check count is pinned
+    so a silently-dropped check block fails loudly here."""
+    import re
+
     assert dump_main(["--selftest"]) == 0
     out = capsys.readouterr().out
-    assert "selftest ok" in out
     assert "FAIL" not in out
+    m = re.search(r"selftest ok \((\d+) checks\)", out)
+    assert m, out
+    assert int(m.group(1)) == 58
+    # the multi-tenant series checks are part of the suite
+    assert "ok: prometheus carries the per-tenant labels" in out
+    assert "ok: prometheus carries the fleet gauges" in out
 
 
 # ---------------------------------------------------------------------------

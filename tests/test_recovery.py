@@ -542,3 +542,50 @@ def test_recovery_survives_mixed_version_directory(tmp_path):
     )
     assert inj.fired == 1
     assert out == full
+
+
+def test_mixed_version_directory_skips_newer_format(tmp_path):
+    """The straddle in the OTHER direction (regression for the v10
+    tenancy bump): a snapshot written by a newer build — e.g. v10 with
+    tenancy meta — must be skipped by this reader with a
+    ``checkpoint_skipped`` breadcrumb, restoring from the newest
+    snapshot this build can actually load. A rolled-back binary must
+    never crash on its successor's checkpoints."""
+    from tpustream.runtime.checkpoint import FORMAT_VERSION
+
+    run_supervised(LINES, ckdir=tmp_path)
+    snaps = _snaps(tmp_path)
+    assert len(snaps) >= 2
+    newest, older = snaps[-1], snaps[-2]
+    _rewrite_format_version(newest, FORMAT_VERSION + 1)
+    reason = validate_checkpoint(newest)
+    assert reason is not None and "version" in reason
+
+    class Ring:
+        def __init__(self):
+            self.events = []
+
+        def record(self, kind, **payload):
+            self.events.append((kind, payload))
+
+    ring = Ring()
+    picked = latest_checkpoint(str(tmp_path), flight=ring)
+    assert picked == older
+    assert validate_checkpoint(picked) is None
+    assert any(
+        k == "checkpoint_skipped"
+        and p["path"] == newest
+        and "version" in p["reason"]
+        for k, p in ring.events
+    )
+
+    # and end to end: crash with the future-format snapshot newest —
+    # the restart restores from the older current-format one, output
+    # byte-identical to an uninterrupted run
+    _, full, _ = run_supervised(LINES)
+    inj = FaultInjector(FaultPoint("device_step", at=2))
+    _, out, _ = run_supervised(
+        LINES, ckdir=tmp_path, strategy=fixed_delay(3, 0.0), injector=inj,
+    )
+    assert inj.fired == 1
+    assert out == full

@@ -54,6 +54,7 @@ from .broadcast import (  # noqa: E402
 from .cep import CEP, Pattern, PatternSelectFunction  # noqa: E402
 from .config import StreamConfig  # noqa: E402
 from .runtime.supervisor import RestartStrategies  # noqa: E402
+from .tenancy import JobServer, TenantPlan, TenantQuota  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -64,6 +65,7 @@ __all__ = [
     "BroadcastStream",
     "CEP",
     "FilterFunction",
+    "JobServer",
     "KeySelector",
     "MapFunction",
     "OutputTag",
@@ -78,6 +80,8 @@ __all__ = [
     "RuleUpdate",
     "StreamConfig",
     "StreamExecutionEnvironment",
+    "TenantPlan",
+    "TenantQuota",
     "Time",
     "TimeCharacteristic",
     "Tuple2",

@@ -25,13 +25,23 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
 F64 = "f64"
 I64 = "i64"
 BOOL = "bool"
+
+# Reserved per-tenant liveness flag: rows of a removed tenant are dropped
+# by a filter on this rule, so remove_tenant is a buffer write, not a
+# rebuild. Declared automatically by RuleSet.enable_tenancy().
+TENANT_ACTIVE_RULE = "__tenant_active__"
+
+# Key under which per-tenant vectors ride RuleSet.values() / load() —
+# checkpoints carry the whole tenant rule table through the existing
+# rule_values meta field without a schema change of their own.
+TENANT_VALUES_KEY = "__tenant__"
 
 def _to_bool(v) -> bool:
     # control lines arrive as text: "false"/"off"/"0" must not truthy
@@ -74,6 +84,10 @@ class RuleUpdate:
     name: str
     value: Any
     after_records: int = 0
+    #: None = a global update (every tenant slot); an int = that tenant's
+    #: slot only. Scoped updates are what make one control feed serve a
+    #: whole fleet — same barriers, same replay determinism.
+    tenant: Optional[int] = None
 
 
 class RuleParam:
@@ -152,6 +166,11 @@ class RuleSet:
     def __init__(self, *descriptors: RuleDescriptor):
         self._desc: Dict[str, RuleDescriptor] = {}
         self._values: Dict[str, Any] = {}
+        #: 0 = scalar mode (PR 6 behaviour, 0-d leaves). > 0 = tenant
+        #: mode: every rule is a [tenant_capacity] vector leaf and each
+        #: record's row is gathered by its tenant slot inside the step.
+        self.tenant_capacity = 0
+        self._tenant_values: Dict[str, list] = {}
         self.version = 0
         self._tls = threading.local()
         for d in descriptors:
@@ -162,7 +181,52 @@ class RuleSet:
             raise ValueError(f"rule {d.name!r} declared twice")
         self._desc[d.name] = d
         self._values[d.name] = _KIND_COERCE[d.kind](d.default)
+        if self.tenant_capacity:
+            self._tenant_values[d.name] = (
+                [self._values[d.name]] * self.tenant_capacity
+            )
         return RuleParam(self, d.name)
+
+    # ---- multi-tenant vector mode -------------------------------------
+    def enable_tenancy(self, capacity: int = 64) -> None:
+        """Switch every rule leaf from a 0-d scalar to a [capacity]
+        vector (capacity rounded up to a power of two so growth follows
+        the key-table doubling discipline). Slots start at the scalar
+        value; the reserved ``__tenant_active__`` BOOL rule is declared
+        with default False so unclaimed slots contribute nothing."""
+        if capacity < 1:
+            raise ValueError(f"tenant capacity must be >= 1, got {capacity}")
+        cap = 1
+        while cap < capacity:
+            cap *= 2
+        if TENANT_ACTIVE_RULE not in self._desc:
+            self._add(RuleDescriptor(
+                TENANT_ACTIVE_RULE, False, BOOL,
+                "reserved: per-tenant liveness mask",
+            ))
+        if self.tenant_capacity and cap <= self.tenant_capacity:
+            return
+        old = self.tenant_capacity
+        self.tenant_capacity = cap
+        for name in self._desc:
+            have = self._tenant_values.get(name, []) if old else []
+            fill = [self._values[name]] * (cap - len(have))
+            self._tenant_values[name] = list(have) + fill
+
+    def ensure_tenant_slot(self, slot: int) -> None:
+        """Grow (doubling) until ``slot`` is addressable. A capacity
+        change alters leaf SHAPES, so the runner must notice via
+        ``refresh_rules`` and rebuild with a tagged cause — see
+        Runner._grow_tenant_capacity."""
+        if not self.tenant_capacity:
+            raise RuntimeError("enable_tenancy() before addressing slots")
+        if slot < 0:
+            raise ValueError(f"tenant slot must be >= 0, got {slot}")
+        cap = self.tenant_capacity
+        while slot >= cap:
+            cap *= 2
+        if cap != self.tenant_capacity:
+            self.enable_tenancy(cap)
 
     def declare(self, name: str, default: Any, kind: str = F64,
                 description: str = "") -> RuleParam:
@@ -190,7 +254,16 @@ class RuleSet:
         return self._values[name]
 
     def values(self) -> Dict[str, Any]:
-        return dict(self._values)
+        out = dict(self._values)
+        if self.tenant_capacity:
+            out[TENANT_VALUES_KEY] = {
+                "capacity": self.tenant_capacity,
+                "vectors": {
+                    name: list(vec)
+                    for name, vec in self._tenant_values.items()
+                },
+            }
+        return out
 
     def __len__(self) -> int:
         return len(self._desc)
@@ -201,27 +274,78 @@ class RuleSet:
     def apply(self, update: RuleUpdate) -> None:
         """Apply one update to the host-side values and bump version."""
         d = self.descriptor(update.name)
-        self._values[update.name] = _KIND_COERCE[d.kind](update.value)
+        v = _KIND_COERCE[d.kind](update.value)
+        if update.tenant is not None:
+            if not self.tenant_capacity:
+                raise RuntimeError(
+                    f"tenant-scoped update for {update.name!r} but "
+                    "tenancy is not enabled on this RuleSet"
+                )
+            self.ensure_tenant_slot(update.tenant)
+            self._tenant_values[update.name][update.tenant] = v
+        else:
+            self._values[update.name] = v
+            if self.tenant_capacity:
+                # a global update reaches every tenant, claimed or not
+                self._tenant_values[update.name] = (
+                    [v] * self.tenant_capacity
+                )
         self.version += 1
+
+    def tenant_value(self, name: str, slot: int):
+        """Host-side value of one rule for one tenant slot."""
+        self.descriptor(name)
+        if not self.tenant_capacity:
+            return self._values[name]
+        return self._tenant_values[name][slot]
 
     def reset(self) -> None:
         """Back to the declared defaults at version 0. A from-scratch
         restart replays the data stream from record 0, so the rule
         timeline must replay with it — the control feed re-applies
-        every update at its original record boundary."""
+        every update (tenant-scoped ones included) at its original
+        record boundary. Tenant CAPACITY is kept: the replayed schedule
+        addresses the same slots, and shrinking leaves mid-restart would
+        force an untagged rebuild."""
         for name, d in self._desc.items():
             self._values[name] = _KIND_COERCE[d.kind](d.default)
+            if self.tenant_capacity:
+                self._tenant_values[name] = (
+                    [self._values[name]] * self.tenant_capacity
+                )
         self.version = 0
 
     def load(self, values: Dict[str, Any], version: int) -> None:
         """Restore host values + version from a checkpoint."""
+        values = dict(values)
+        tenant = values.pop(TENANT_VALUES_KEY, None)
         for name, v in values.items():
             if name in self._desc:
                 self._values[name] = _KIND_COERCE[self._desc[name].kind](v)
+        if tenant:
+            self.enable_tenancy(int(tenant.get("capacity", 1)))
+            for name, vec in tenant.get("vectors", {}).items():
+                if name in self._desc:
+                    co = _KIND_COERCE[self._desc[name].kind]
+                    vec = [co(v) for v in vec]
+                    # pad to capacity with the scalar fallback
+                    pad = self.tenant_capacity - len(vec)
+                    if pad > 0:
+                        vec = vec + [self._values[name]] * pad
+                    self._tenant_values[name] = vec[: self.tenant_capacity]
         self.version = int(version)
 
     def device_leaves(self) -> Dict[str, Any]:
-        """The rule pytree: {name: 0-d array} of the CURRENT values."""
+        """The rule pytree of the CURRENT values: {name: 0-d array} in
+        scalar mode, {name: [tenant_capacity] array} in tenant mode."""
+        if self.tenant_capacity:
+            return {
+                name: jnp.asarray(
+                    self._tenant_values[name],
+                    _KIND_DTYPES[self._desc[name].kind],
+                )
+                for name in self.names()
+            }
         return {
             name: jnp.asarray(
                 self._values[name], _KIND_DTYPES[self._desc[name].kind]
@@ -243,11 +367,34 @@ class RuleSet:
         finally:
             stack.pop()
 
+    @contextmanager
+    def bound_tenant(self, tid):
+        """Bind the CURRENT RECORD's tenant slot for the duration of one
+        per-record fn call inside the step trace. While active, a
+        RuleParam whose bound leaf is a [T] vector resolves to
+        ``leaf[tid]`` — a scalar gather the batcher (vmap) turns into
+        one batched gather per rule, so N tenants share one program."""
+        prev = getattr(self._tls, "tenant", None)
+        self._tls.tenant = tid
+        try:
+            yield
+        finally:
+            self._tls.tenant = prev
+
     def _bound_leaf(self, name: str):
         stack = getattr(self._tls, "stack", None)
-        if stack:
-            return stack[-1].get(name)
-        return None
+        if not stack:
+            return None
+        leaf = stack[-1].get(name)
+        if leaf is None:
+            return None
+        tid = getattr(self._tls, "tenant", None)
+        if tid is not None and getattr(leaf, "ndim", 0) == 1:
+            idx = jnp.clip(
+                jnp.asarray(tid).astype(jnp.int32), 0, leaf.shape[0] - 1
+            )
+            return leaf[idx]
+        return leaf
 
     def get_version(self) -> int:
         return self.version

@@ -131,7 +131,10 @@ class ControlFeed:
             fresh, self._live_buf = self._live_buf, []
         for u in fresh:
             self._schedule.append(
-                RuleUpdate(u.name, u.value, max(u.after_records, consumed))
+                RuleUpdate(
+                    u.name, u.value, max(u.after_records, consumed),
+                    tenant=u.tenant,
+                )
             )
         if fresh:
             self._schedule.sort(key=lambda u: u.after_records)

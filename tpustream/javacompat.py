@@ -29,6 +29,13 @@ from .cep import CEP, Pattern, PatternSelectFunction  # noqa: F401 — the
 # (Pattern.begin(..).followedBy(..).within(..), PatternStream
 # .sideOutputLateData) so chapter-style jobs read like the original
 from .hostparse import PExpr, SymNum, SymStr
+from .tenancy import (  # noqa: F401 — the multi-tenant serving surface
+    # (JobServer.addTenant/removeTenant/updateTenantRules camelCase
+    # aliases) re-exported to match the CEP/broadcast convention
+    JobServer,
+    TenantPlan,
+    TenantQuota,
+)
 from .utils.timeutil import iso_local_to_epoch_sec
 
 

@@ -424,6 +424,14 @@ def _selftest() -> int:
     g.gauge("controller_async_depth").set(3)
     g.gauge("controller_objective_rows_per_s").set(123456.0)
     g.counter("controller_decisions_total").inc(4)
+    # multi-tenant fleet series (docs/multitenancy.md): the fleet-size
+    # gauge plus per-tenant-labeled admission/quota/rule-version series
+    # the JobServer mints through the same group path
+    g.gauge("tenant_count").set(2)
+    tg = g.group(tenant="acme")
+    tg.counter("tenant_records_total").set_total(512)
+    tg.counter("tenant_quota_exceeded_total").set_total(3)
+    tg.gauge("tenant_rule_version").set(4)
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -547,6 +555,16 @@ def _selftest() -> int:
          'controller_async_depth{job="selftest"} 3' in prom
          and 'controller_decisions_total{job="selftest"} 4' in prom
          and 'controller_objective_rows_per_s{job="selftest"} 123456'
+         in prom),
+        ("render names the tenancy series",
+         "tenant_count" in text and "tenant_records_total" in text),
+        ("prometheus carries the per-tenant labels",
+         'tenant_records_total{job="selftest",tenant="acme"} 512' in prom
+         and 'tenant_quota_exceeded_total{job="selftest",tenant="acme"} 3'
+         in prom),
+        ("prometheus carries the fleet gauges",
+         'tenant_count{job="selftest"} 2' in prom
+         and 'tenant_rule_version{job="selftest",tenant="acme"} 4'
          in prom),
     ]
     checks.extend(_selftest_timeseries())

@@ -59,7 +59,12 @@ import numpy as np
 # job's state pytree carries rule leaves (__rules__/__rule_version__),
 # and meta records the host RuleSet's values plus its applied-update
 # count so a restore re-syncs the control-feed cursor exactly-once
-FORMAT_VERSION = 9
+# v10: multi-tenancy (tpustream/tenancy) — rule leaves may be [T]
+# per-tenant vectors (rule_values carries the tenant table under
+# "__tenant__"), and meta gains a ``tenancy`` dict: the JobServer's
+# tenant→slot map, admitted/quota counters, and slot capacity, so a
+# supervised restart restores the whole fleet exactly-once
+FORMAT_VERSION = 10
 _META_KEY = "__meta__"
 
 
@@ -140,6 +145,11 @@ class Checkpoint:
     # control feed skips exactly the already-applied schedule prefix.
     rule_values: Optional[dict] = None
     rule_version: int = 0
+    # multi-tenancy (tpustream/tenancy): the JobServer's host-side
+    # fleet state at snapshot time — tenant→slot map, per-tenant
+    # admitted/quota-rejected counters, slot capacity. The per-tenant
+    # rule VECTORS ride rule_values["__tenant__"] above.
+    tenancy: Optional[dict] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -304,6 +314,7 @@ def save_checkpoint(
     session: Optional[str] = None,
     rule_values: Optional[dict] = None,
     rule_version: int = 0,
+    tenancy: Optional[dict] = None,
 ) -> str:
     """Snapshot to ``directory/ckpt-<source_pos>.npz`` (atomic
     write-to-.tmp + ``os.replace``); prunes to the ``keep`` newest
@@ -335,6 +346,7 @@ def save_checkpoint(
         "session": session,
         "rule_values": rule_values,
         "rule_version": int(rule_version),
+        "tenancy": tenancy,
         "checksum": _checksum(leaves),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(leaves)}
@@ -477,4 +489,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         session=meta.get("session"),
         rule_values=meta.get("rule_values"),
         rule_version=meta.get("rule_version", 0),
+        tenancy=meta.get("tenancy"),
     )

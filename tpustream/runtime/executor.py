@@ -650,10 +650,17 @@ class Runner:
 
     def refresh_rules(self):
         """Swap the device rule leaves to the RuleSet's CURRENT values
-        and version: two tiny H2D transfers, never a recompile — the
-        jitted step reads rules as runtime data (tpustream/broadcast).
-        On a mesh the 0-d leaves re-place replicated (P()), so every
-        shard applies version N at the same batch boundary."""
+        and version: tiny H2D transfers, never a recompile — the jitted
+        step reads rules as runtime data (tpustream/broadcast). On a
+        mesh the leaves re-place replicated (P()), so every shard
+        applies version N at the same batch boundary.
+
+        One exception: when tenant capacity GREW since the last swap
+        (tpustream/tenancy admitted a slot past the current [T]), the
+        leaf shapes change and a silent jit retrace would follow with no
+        cause attribution. That case routes through
+        :meth:`_grow_tenant_capacity` — drained, flight-recorded, and
+        cause-tagged like key-capacity growth."""
         ruleset = getattr(self.program, "ruleset", None)
         if (
             ruleset is None
@@ -662,6 +669,21 @@ class Runner:
         ):
             return
         leaves = ruleset.device_leaves()
+        old = self.state[RULES_KEY]
+        if any(
+            tuple(getattr(v, "shape", ())) != tuple(
+                getattr(old.get(k), "shape", ())
+            )
+            for k, v in leaves.items()
+        ) or set(leaves) != set(old):
+            self._grow_tenant_capacity()
+            return
+        self._swap_rule_leaves(leaves)
+
+    def _swap_rule_leaves(self, leaves):
+        """Place {name: array} rule leaves + the version scalar into
+        ``self.state`` (replicated on a mesh)."""
+        ruleset = self.program.ruleset
         version = jnp.asarray(ruleset.version, jnp.int64)
         mesh = getattr(self.program, "mesh", None)
         if mesh is not None:
@@ -683,6 +705,36 @@ class Runner:
         state[RULES_KEY] = leaves
         state[RULE_VERSION_KEY] = version
         self.state = state
+
+    def _grow_tenant_capacity(self, cause: str = "tenant_capacity_growth"):
+        """Re-shape the rule subtree after the RuleSet's tenant capacity
+        changed (slot admission past [T] doubles the vectors — the
+        tenancy analogue of `_grow_key_capacity`). Only the rule leaves
+        change shape, so no state migration is needed; the step is
+        rebuilt cause-tagged so the compile registry attributes the
+        retrace to tenant growth instead of a silent miss."""
+        ruleset = self.program.ruleset
+        self.drain_inflight()
+        old = self.state[RULES_KEY]
+        old_cap = next(
+            (
+                v.shape[0]
+                for v in old.values()
+                if getattr(v, "ndim", 0) == 1
+            ),
+            0,
+        )
+        self._flight.record(
+            "tenant_capacity_grown",
+            operator=self.obs.name or self.program.operator_name,
+            old_capacity=old_cap,
+            new_capacity=ruleset.tenant_capacity,
+            cause=cause,
+        )
+        self._recompile_cause = cause
+        self.step = None
+        self._empty_cache = None
+        self._swap_rule_leaves(ruleset.device_leaves())
 
     def _check_capacity(self):
         """Keyed state grows without bound, Flink's contract
@@ -2454,8 +2506,14 @@ def _execute_job(env, sink_nodes) -> JobResult:
             # sync the host RuleSet to the snapshot's rule timeline
             # BEFORE programs build: init_state seeds the rule leaves
             # from it, and the control-feed cursor (= version) skips the
-            # already-applied schedule prefix during replay
+            # already-applied schedule prefix during replay. In tenant
+            # mode this also restores capacity + per-tenant vectors
+            # (rule_values["__tenant__"]).
             plan.rules.load(ck.rule_values, ck.rule_version)
+        if ck.tenancy is not None and getattr(env, "_tenancy", None) is not None:
+            # the JobServer's host fleet state (tenant->slot map,
+            # admitted/quota counters) restores alongside the vectors
+            env._tenancy.load_state_dict(ck.tenancy)
         runner = _make_runner_chain(
             plans, cfg, metrics, lazy_schemas=ck.lazy_schemas
         )
@@ -2558,18 +2616,39 @@ def _execute_job(env, sink_nodes) -> JobResult:
             ruleset.apply(u)
         for r in runner.chain():
             r.refresh_rules()
+        tenant_slots = sorted(
+            {
+                u.tenant for u in updates
+                if getattr(u, "tenant", None) is not None
+            }
+        )
         if fault is not None:
             # the crash window between rule application and the next
             # data batch: recovery must re-apply the update at the same
             # record boundary for byte-identical output
             fault("control_apply")
+            if tenant_slots:
+                # narrower window for the tenancy playbook: only fires
+                # when a TENANT-scoped update (add/remove/update_rules)
+                # was in the applied group
+                fault("tenant_apply")
         job_obs.gauge("rule_version").set(ruleset.version)
         job_obs.counter("rule_updates_total").inc(len(updates))
+        if job_obs.enabled and tenant_slots:
+            srv = getattr(env, "_tenancy", None)
+            for slot in tenant_slots:
+                label = (
+                    srv.tenant_label(slot) if srv is not None else str(slot)
+                )
+                job_obs.group.group(tenant=label).gauge(
+                    "tenant_rule_version"
+                ).set(ruleset.version)
         job_obs.flight.record(
             "rule_applied",
             old_version=old_version,
             new_version=ruleset.version,
             rules={u.name: ruleset.value(u.name) for u in updates},
+            tenants=tenant_slots or None,
         )
 
     def _feed_measured(b, wm_low, t0):
@@ -2941,6 +3020,14 @@ def _execute_job(env, sink_nodes) -> JobResult:
                     ),
                     rule_version=(
                         ruleset.version if ruleset is not None else 0
+                    ),
+                    # multi-tenancy: the JobServer's host fleet state
+                    # (tenant->slot map, admitted/quota counters); the
+                    # per-tenant rule vectors ride rule_values above
+                    tenancy=(
+                        env._tenancy.state_dict()
+                        if getattr(env, "_tenancy", None) is not None
+                        else None
                     ),
                 )
             # snapshot cost series (docs/observability.md)

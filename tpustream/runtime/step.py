@@ -132,6 +132,21 @@ class BaseProgram:
     def jitted_step(self):
         return jax.jit(self.traced_step(), donate_argnums=0)
 
+    def _replicate_rule_specs(self, specs: dict) -> dict:
+        """Force P() on the rule subtree. Rule leaves are replicated by
+        contract — and in tenant mode they are [T] vectors indexed by
+        tenant slot, which a shape-based ndim rule (RollingProgram's
+        ``ndim >= 1``) would wrongly shard over the key axis."""
+        from jax.sharding import PartitionSpec as P
+
+        if RULES_KEY in specs:
+            specs = dict(specs)
+            specs[RULES_KEY] = jax.tree_util.tree_map(
+                lambda _: P(), specs[RULES_KEY]
+            )
+            specs[RULE_VERSION_KEY] = P()
+        return specs
+
     def state_specs(self, state):
         """Mesh sharding specs for the state pytree (default: arrays with
         a leading key axis of ndim >= 2 shard on it, scalars replicate).
@@ -140,9 +155,9 @@ class BaseProgram:
 
         from ..parallel.mesh import AXIS
 
-        return jax.tree_util.tree_map(
+        return self._replicate_rule_specs(jax.tree_util.tree_map(
             lambda leaf: P(AXIS) if leaf.ndim >= 2 else P(), state
-        )
+        ))
 
     def rescale_key_leaf(self, arr: np.ndarray, from_parallelism: int):
         """Re-lay a key-sharded state leaf saved at a different
@@ -405,9 +420,9 @@ class RollingProgram(BaseProgram):
         from ..parallel.mesh import AXIS
 
         # rolling state: seen [K], storage planes [K] -> sharded on axis 0
-        return jax.tree_util.tree_map(
+        return self._replicate_rule_specs(jax.tree_util.tree_map(
             lambda leaf: P(AXIS) if leaf.ndim >= 1 else P(), state
-        )
+        ))
 
     def _step(self, state, cols, valid, ts, wm_lower):
         mid_cols, mask = self._apply_pre(cols, valid)

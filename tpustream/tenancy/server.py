@@ -1,0 +1,546 @@
+"""JobServer: multiplex N logical jobs onto ONE compiled mesh step.
+
+The serving layer over :class:`TenantPlan`. Every admitted tenant runs
+the fleet's template chain, and the whole fleet shares one compiled XLA
+program — tenant isolation is a data-layout property, never a compile
+property:
+
+* **key namespace** — the tenant's slot id is folded into the template's
+  STR key field at parse time (``"<slot>\\x1f<key>"``), so the existing
+  HBM key table partitions into per-tenant namespaces and dynamic key
+  growth / checkpoint restore work unchanged;
+* **rule rows** — PR 6's rule leaves become ``[T]`` vectors
+  (:meth:`RuleSet.enable_tenancy`); each record carries its tenant slot
+  as a trailing i64 field and every proxied user fn runs under
+  :meth:`RuleSet.bound_tenant`, so a RuleParam resolves to
+  ``leaf[slot]`` — one batched gather per rule inside the step;
+* **liveness** — a reserved ``__tenant_active__`` BOOL rule row gates
+  every record through a prepended filter: ``remove_tenant`` is a
+  buffer write that starts dropping the tenant's rows at an exact
+  record boundary, zero recompiles;
+* **control plane** — ``add_tenant`` / ``remove_tenant`` /
+  ``update_tenant_rules`` land as tenant-scoped
+  :class:`~tpustream.broadcast.RuleUpdate`\\ s on the standard broadcast
+  feed, applied at existing batch-split barriers, replay-deterministic
+  across supervised restarts;
+* **quota** — per-tenant record quotas divert over-quota lines to a
+  ``quota_exceeded`` side output at admission, before they cost any
+  device time;
+* **demux** — sink output lands in one collect handle (so checkpoint
+  sink-count rollback works unchanged) and splits back per tenant on
+  read, with the namespace prefix stripped — a tenant's output is
+  byte-identical to running its job alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..api.datastream import DataStream, KeyedStream, WindowedStream
+from ..api.graph import Node
+from ..api.tuples import TupleBase, make_tuple
+from ..broadcast.rules import (
+    TENANT_ACTIVE_RULE,
+    RuleParam,
+    RuleSet,
+    RuleUpdate,
+)
+from ..config import StreamConfig
+from .plan import TenantPlan, TenantQuota
+
+#: separates the tenant slot from the payload in tagged source lines and
+#: from the user key in namespaced key strings (an ASCII unit separator
+#: — vanishingly unlikely in monitoring keys, and cheap to strip)
+TENANT_SEP = "\x1f"
+
+
+def _vals(rec) -> List[Any]:
+    if isinstance(rec, (TupleBase, tuple)):
+        return list(rec)
+    return [rec]
+
+
+def _pack(vals: Sequence[Any]):
+    if len(vals) == 1:
+        return vals[0]
+    if len(vals) <= 4:
+        return make_tuple(*vals)
+    return tuple(vals)
+
+
+def _wrap_map(rules: RuleSet, fn):
+    """Trace the user map fn with (a) the tenant field hidden and (b)
+    the record's tenant slot bound, so RuleParams gather their row."""
+
+    def tenant_map(rec):
+        vals = _vals(rec)
+        tid = vals[-1]
+        with rules.bound_tenant(tid):
+            out = fn(_pack(vals[:-1]))
+            out_vals = _vals(out)
+        return _pack(out_vals + [tid])
+
+    return tenant_map
+
+
+def _wrap_filter(rules: RuleSet, fn):
+    def tenant_filter(rec):
+        vals = _vals(rec)
+        tid = vals[-1]
+        with rules.bound_tenant(tid):
+            keep = fn(_pack(vals[:-1]))
+            # a bare RuleParam (e.g. a BOOL rule used AS the predicate)
+            # must resolve INSIDE the tenant binding, not later at the
+            # mask logical_and
+            if isinstance(keep, RuleParam):
+                keep = jnp.asarray(keep)
+        return keep
+
+    return tenant_filter
+
+
+def _wrap_reduce(rules: RuleSet, fn):
+    """Two-record reduce: both carry the same tenant slot (keys are
+    tenant-namespaced), so bind from the first and reattach it."""
+
+    def tenant_reduce(a, b):
+        va, vb = _vals(a), _vals(b)
+        tid = va[-1]
+        with rules.bound_tenant(tid):
+            out = fn(_pack(va[:-1]), _pack(vb[:-1]))
+            out_vals = _vals(out)
+        return _pack(out_vals + [tid])
+
+    return tenant_reduce
+
+
+class _TenantStream:
+    """The DataStream the template build fn sees: every user fn is
+    wrapped so the trailing tenant field stays invisible and rule
+    resolution is per-tenant. Mirrors the DataStream surface the
+    TenantPlan shape probe accepts."""
+
+    def __init__(self, stream: DataStream, rules: RuleSet):
+        self._stream = stream
+        self._rules = rules
+
+    @property
+    def node(self) -> Node:
+        return self._stream.node
+
+    @property
+    def env(self):
+        return self._stream.env
+
+    def map(self, fn) -> "_TenantStream":
+        return _TenantStream(
+            self._stream.map(_wrap_map(self._rules, fn)), self._rules
+        )
+
+    def filter(self, fn) -> "_TenantStream":
+        return _TenantStream(
+            self._stream.filter(_wrap_filter(self._rules, fn)), self._rules
+        )
+
+    def flat_map(self, fn):
+        raise NotImplementedError(
+            "flat_map on a tenant fleet stream is not supported yet"
+        )
+
+    flatMap = flat_map
+
+    def assign_timestamps_and_watermarks(self, assigner) -> "_TenantStream":
+        return _TenantStream(
+            self._stream.assign_timestamps_and_watermarks(assigner),
+            self._rules,
+        )
+
+    assignTimestampsAndWatermarks = assign_timestamps_and_watermarks
+
+    def key_by(self, key) -> "_TenantKeyedStream":
+        # the tenant field is LAST, so positional keys are unchanged;
+        # the key column itself is already tenant-namespaced at parse
+        return _TenantKeyedStream(self._stream.key_by(key), self._rules)
+
+    keyBy = key_by
+
+
+class _TenantKeyedStream(_TenantStream):
+    _stream: KeyedStream
+
+    def _rolling(self, kind: str, pos: int) -> _TenantStream:
+        # rolling Flink semantics: only the aggregated field updates,
+        # others keep first-seen values — within a (namespaced) key the
+        # tenant field is constant, so it rides through correctly
+        return _TenantStream(self._stream._rolling(kind, pos), self._rules)
+
+    def max(self, pos: int) -> _TenantStream:
+        return self._rolling("max", pos)
+
+    def min(self, pos: int) -> _TenantStream:
+        return self._rolling("min", pos)
+
+    def sum(self, pos: int) -> _TenantStream:
+        return self._rolling("sum", pos)
+
+    def max_by(self, pos: int) -> _TenantStream:
+        return self._rolling("max_by", pos)
+
+    def min_by(self, pos: int) -> _TenantStream:
+        return self._rolling("min_by", pos)
+
+    maxBy = max_by
+    minBy = min_by
+
+    def reduce(self, fn) -> _TenantStream:
+        return _TenantStream(
+            self._stream.reduce(_wrap_reduce(self._rules, fn)), self._rules
+        )
+
+    def time_window(self, size, slide=None) -> "_TenantWindowedStream":
+        return _TenantWindowedStream(
+            self._stream.time_window(size, slide), self._rules
+        )
+
+    timeWindow = time_window
+
+    def count_window(self, count: int, slide=None) -> "_TenantWindowedStream":
+        return _TenantWindowedStream(
+            self._stream.count_window(count, slide), self._rules
+        )
+
+    countWindow = count_window
+
+    def window(self, spec) -> "_TenantWindowedStream":
+        return _TenantWindowedStream(self._stream.window(spec), self._rules)
+
+
+class _TenantWindowedStream:
+    def __init__(self, stream: WindowedStream, rules: RuleSet):
+        self._stream = stream
+        self._rules = rules
+
+    def allowed_lateness(self, t) -> "_TenantWindowedStream":
+        self._stream.allowed_lateness(t)
+        return self
+
+    allowedLateness = allowed_lateness
+
+    def side_output_late_data(self, tag) -> "_TenantWindowedStream":
+        self._stream.side_output_late_data(tag)
+        return self
+
+    sideOutputLateData = side_output_late_data
+
+    def reduce(self, fn) -> _TenantStream:
+        return _TenantStream(
+            self._stream.reduce(_wrap_reduce(self._rules, fn)), self._rules
+        )
+
+    def aggregate(self, fn):
+        raise NotImplementedError(
+            "window aggregate() on a tenant fleet stream is not "
+            "supported yet — express the aggregation as reduce()"
+        )
+
+    def process(self, fn):
+        raise NotImplementedError(
+            "window process() on a tenant fleet stream is not supported yet"
+        )
+
+    def sum(self, pos: int) -> _TenantStream:
+        from ..api.datastream import _field_sum
+
+        return self.reduce(_field_sum(pos))
+
+    def max(self, pos: int) -> _TenantStream:
+        from ..api.datastream import _field_extreme
+
+        return self.reduce(_field_extreme(pos, True))
+
+    def min(self, pos: int) -> _TenantStream:
+        from ..api.datastream import _field_extreme
+
+        return self.reduce(_field_extreme(pos, False))
+
+
+class TenantDemuxHandle:
+    """The fleet's single collect sink. A FLAT ``items`` list, exactly
+    like CollectHandle, so checkpoint sink-count rollback (``del
+    items[keep:]``) restores the fleet's output exactly-once; the
+    per-tenant split happens at read time (JobServer.output)."""
+
+    def __init__(self) -> None:
+        self.items: list = []
+
+    def append(self, item) -> None:
+        self.items.append(item)
+
+
+class JobServer:
+    """Front-end of a multi-tenant fleet over one TenantPlan.
+
+    Lifecycle: construct → ``add_tenant`` / ``ingest`` /
+    ``update_tenant_rules`` / ``remove_tenant`` in any interleaving
+    (control calls take effect at the exact stream position they were
+    made at) → ``run()`` once → read ``output(tenant)`` /
+    ``quota_output(tenant)``.
+    """
+
+    def __init__(
+        self,
+        plan: TenantPlan,
+        config: Optional[StreamConfig] = None,
+    ):
+        self.plan = plan
+        self.config = config or StreamConfig()
+        plan.rules.enable_tenancy(plan.tenant_capacity)
+        self._key_field = plan.inferred_key_field()
+        self._tenants: Dict[str, int] = {}          # tenant id -> slot
+        self._active: Dict[str, bool] = {}
+        self._quota: Dict[str, Optional[int]] = {}
+        self._admitted: Dict[str, int] = {}
+        self._rejected: Dict[str, int] = {}
+        self._lines: List[str] = []                 # tagged, admission order
+        self._positions: Dict[str, List[int]] = {}  # per-tenant absolute pos
+        self._updates: List[RuleUpdate] = []        # the control schedule
+        self._quota_log: Dict[str, List[str]] = {}
+        self._handle = TenantDemuxHandle()
+        self.env = None
+
+    # -- fleet control (position-addressed: effective at the stream
+    # -- position of the call, exactly) ---------------------------------
+    def add_tenant(
+        self,
+        tenant: str,
+        rules: Optional[Dict[str, Any]] = None,
+        quota: Optional[TenantQuota] = None,
+        build=None,
+    ) -> int:
+        """Admit a tenant at the current stream position: verify its job
+        shape (when it submits one), assign a slot, and schedule its
+        activation + initial rule rows. Returns the slot."""
+        if tenant in self._tenants:
+            raise ValueError(f"tenant {tenant!r} already admitted")
+        if build is not None:
+            self.plan.verify(build)
+        slot = len(self._tenants)
+        pos = len(self._lines)
+        self._tenants[tenant] = slot
+        self._active[tenant] = True
+        self._quota[tenant] = quota.max_records if quota is not None else None
+        self._admitted[tenant] = 0
+        self._rejected[tenant] = 0
+        self._positions[tenant] = []
+        self._quota_log[tenant] = []
+        for name, value in (rules or {}).items():
+            self._updates.append(RuleUpdate(name, value, pos, tenant=slot))
+        self._updates.append(
+            RuleUpdate(TENANT_ACTIVE_RULE, True, pos, tenant=slot)
+        )
+        return slot
+
+    addTenant = add_tenant
+
+    def update_tenant_rules(
+        self, tenant: str, rules: Dict[str, Any],
+        after_records: Optional[int] = None,
+    ) -> None:
+        """Schedule rule-row writes for one tenant, effective at the
+        current stream position (or an explicit absolute one)."""
+        slot = self._slot(tenant)
+        pos = len(self._lines) if after_records is None else after_records
+        for name, value in rules.items():
+            self._updates.append(RuleUpdate(name, value, pos, tenant=slot))
+
+    updateTenantRules = update_tenant_rules
+
+    def remove_tenant(self, tenant: str) -> None:
+        """Deactivate at the current stream position: later records of
+        this tenant drop inside the compiled step (active-row gather),
+        zero recompiles. The slot and tenant id are retained — earlier
+        output stays addressable; re-admitting the same id raises."""
+        slot = self._slot(tenant)
+        self._active[tenant] = False
+        self._updates.append(
+            RuleUpdate(
+                TENANT_ACTIVE_RULE, False, len(self._lines), tenant=slot
+            )
+        )
+
+    removeTenant = remove_tenant
+
+    def ingest(self, tenant: str, lines: Sequence[str]) -> int:
+        """Route records into the shared stream; over-quota lines divert
+        to the tenant's quota_exceeded side output. Returns the number
+        admitted."""
+        slot = self._slot(tenant)
+        tag = f"{slot}{TENANT_SEP}"
+        quota = self._quota[tenant]
+        n = 0
+        for line in lines:
+            if quota is not None and self._admitted[tenant] >= quota:
+                self._rejected[tenant] += 1
+                self._quota_log[tenant].append(line)
+                continue
+            self._positions[tenant].append(len(self._lines))
+            self._lines.append(tag + line)
+            self._admitted[tenant] += 1
+            n += 1
+        return n
+
+    def position(self, tenant: str, n: int) -> int:
+        """Absolute stream position of the tenant's n-th ADMITTED
+        record — the coordinate update_tenant_rules(after_records=...)
+        speaks."""
+        return self._positions[tenant][n]
+
+    def tenants(self) -> List[str]:
+        return list(self._tenants)
+
+    def tenant_label(self, slot: int) -> str:
+        """Obs label for a slot: the tenant id, or the slot number for
+        a slot no admitted tenant maps to."""
+        for tenant, s in self._tenants.items():
+            if s == slot:
+                return tenant
+        return str(slot)
+
+    def _slot(self, tenant: str) -> int:
+        try:
+            return self._tenants[tenant]
+        except KeyError:
+            raise KeyError(
+                f"unknown tenant {tenant!r}; admitted: {sorted(self._tenants)}"
+            ) from None
+
+    # -- execution -------------------------------------------------------
+    def _parse_tagged(self, line: str):
+        """The fleet's host parse: split the tenant tag, run the shared
+        template parse, fold the slot into the key namespace, and append
+        the slot as the trailing i64 field."""
+        slot_s, payload = line.split(TENANT_SEP, 1)
+        slot = int(slot_s)
+        vals = _vals(self.plan.parse(payload))
+        kf = self._key_field
+        if kf is not None:
+            key = vals[kf]
+            if not isinstance(key, str):
+                raise TypeError(
+                    f"tenant key field {kf} must parse to str (the key "
+                    f"namespace folds the tenant id into it), got "
+                    f"{type(key).__name__}"
+                )
+            vals[kf] = f"{slot}{TENANT_SEP}{key}"
+        vals.append(slot)
+        return _pack(vals)
+
+    def build_job(self, env) -> None:
+        """Wire the fleet onto ``env``: tagged data source, control
+        schedule as the broadcast stream, wrapped template chain behind
+        the active-row gate, demux collect sink."""
+        from ..runtime.sources import ReplaySource
+
+        rules = self.plan.rules
+        env._tenancy = self
+        env.add_source(ReplaySource(list(self._updates))).broadcast(rules)
+        stream = _TenantStream(
+            env.from_collection(self._lines).map(self._parse_tagged), rules
+        )
+        # the liveness gate: resolves per record to the tenant's
+        # __tenant_active__ row; removed tenants' rows drop here
+        active = rules.param(TENANT_ACTIVE_RULE)
+        stream = stream.filter(lambda _rec: jnp.asarray(active, jnp.bool_))
+        out = self.plan.build(stream, rules)
+        node = Node("sink_collect", out.node, {"handle": self._handle})
+        env._register_sink(node)
+
+    def run(self, job_name: str = "tenant fleet", restart_strategy=None):
+        """Build the env (once) and execute the fleet to exhaustion."""
+        from ..api.environment import StreamExecutionEnvironment
+
+        if self.env is None:
+            self.env = StreamExecutionEnvironment(self.config)
+            if restart_strategy is not None:
+                self.env.set_restart_strategy(restart_strategy)
+            self.build_job(self.env)
+        result = self.env.execute(job_name)
+        self._mint_obs(job_name)
+        return result
+
+    def _mint_obs(self, job_name: str) -> None:
+        """Per-tenant-labeled series (docs/observability.md): fleet size
+        plus per-tenant admission/quota counters."""
+        metrics = getattr(self.env, "metrics", None)
+        registry = getattr(metrics, "registry", None)
+        if registry is None:
+            return
+        g = registry.group(job=job_name)
+        g.gauge("tenant_count").set(
+            sum(1 for t in self._tenants if self._active[t])
+        )
+        for tenant in self._tenants:
+            tg = g.group(tenant=tenant)
+            tg.counter("tenant_records_total").set_total(
+                self._admitted[tenant]
+            )
+            tg.counter("tenant_quota_exceeded_total").set_total(
+                self._rejected[tenant]
+            )
+
+    # -- output demux ----------------------------------------------------
+    def _strip(self, vals: List[Any], slot: int) -> List[Any]:
+        prefix = f"{slot}{TENANT_SEP}"
+        return [
+            v[len(prefix):]
+            if isinstance(v, str) and v.startswith(prefix)
+            else v
+            for v in vals
+        ]
+
+    def output(self, tenant: str) -> list:
+        """This tenant's records from the shared sink, namespace
+        stripped — byte-identical to a solo run of its job."""
+        slot = self._slot(tenant)
+        out = []
+        for item in self._handle.items:
+            vals = _vals(item)
+            if int(vals[-1]) != slot:
+                continue
+            out.append(_pack(self._strip(vals[:-1], slot)))
+        return out
+
+    def quota_output(self, tenant: str) -> List[str]:
+        """The tenant's quota_exceeded side output: raw lines diverted
+        at admission."""
+        self._slot(tenant)
+        return list(self._quota_log[tenant])
+
+    # -- checkpoint integration -----------------------------------------
+    def state_dict(self) -> dict:
+        """Host fleet state for checkpoint meta (the per-tenant rule
+        VECTORS ride RuleSet.values() separately)."""
+        return {
+            "capacity": self.plan.rules.tenant_capacity,
+            "tenants": dict(self._tenants),
+            "active": dict(self._active),
+            "quota": dict(self._quota),
+            "admitted": dict(self._admitted),
+            "rejected": dict(self._rejected),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        cap = int(state.get("capacity", 0))
+        if cap:
+            self.plan.rules.enable_tenancy(cap)
+        self._tenants = {k: int(v) for k, v in state["tenants"].items()}
+        self._active = dict(state.get("active", {}))
+        self._quota = dict(state.get("quota", {}))
+        self._admitted = {
+            k: int(v) for k, v in state.get("admitted", {}).items()
+        }
+        self._rejected = {
+            k: int(v) for k, v in state.get("rejected", {}).items()
+        }

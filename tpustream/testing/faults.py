@@ -1,6 +1,6 @@
 """Seeded fault injection for supervised-execution tests and bench.
 
-The runtime exposes seven control-plane fault points, checked on the
+The runtime exposes eight control-plane fault points, checked on the
 paths named after them:
 
 * ``source_read``  — before each source batch enters the host stage
@@ -22,6 +22,12 @@ paths named after them:
   (the recovered run must re-apply the update at the same record
   boundary — byte-identical output; see tpustream/broadcast and
   docs/dynamic_rules.md)
+* ``tenant_apply``  — same window, but only when the applied batch of
+  updates contains a TENANT-scoped one (JobServer add_tenant /
+  remove_tenant / update_tenant_rules land as tenant-scoped rule
+  updates): targets crash recovery of the multi-tenant fleet mid
+  admission or rule change (see tpustream/tenancy and
+  docs/multitenancy.md)
 
 An injector installs into ``StreamConfig.extra["fault_injector"]`` (use
 :meth:`FaultInjector.install`); the executor reads it from there so the
@@ -49,6 +55,7 @@ FAULT_POINTS = (
     "exchange",
     "sink_emit",
     "control_apply",
+    "tenant_apply",
 )
 
 
