@@ -1,0 +1,494 @@
+"""Pre-flight plan analyzer (tpustream/analysis, docs/analysis.md).
+
+Contracts pinned here:
+
+* every plan-lint rule has a BROKEN job that produces its exact TSM0xx
+  code and a clean job that does not;
+* the purity analyzer flags mutable closures, nondeterministic calls,
+  device side effects, host callbacks, and dtype-widening maps — and
+  stays silent on the pure equivalents;
+* ``strict_analysis=True`` raises PlanAnalysisError at submission,
+  BEFORE any planning or tracing;
+* with obs enabled, findings surface as
+  ``analysis_findings_total{code=...}`` counters and flight breadcrumbs;
+* ``python -m tpustream.analysis.lint`` exits 0/1/2 correctly and all
+  nine chapter jobs self-lint with zero errors.
+
+Everything except the obs-integration test constructs graphs without
+executing them — analysis is pure inspection.
+"""
+
+import io
+import textwrap
+
+import numpy as np
+import pytest
+
+from tpustream import (
+    CEP,
+    OutputTag,
+    Pattern,
+    PlanAnalysisError,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple3,
+)
+from tpustream.analysis import (
+    CATALOG,
+    ERROR,
+    INFO,
+    WARN,
+    analyze,
+    analyze_callable,
+    check_dtype_widening,
+    has_errors,
+)
+from tpustream.analysis.lint import main as lint_main
+from tpustream.api.datastream import KeyedStream
+from tpustream.api.graph import Node
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.jobs.chapter1_threshold import parse
+from tpustream.runtime.sources import ReplaySource
+from tpustream.runtime.supervisor import fixed_delay
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def make_env(**cfg) -> StreamExecutionEnvironment:
+    return StreamExecutionEnvironment(StreamConfig(**cfg))
+
+
+def good_job(env=None):
+    """A clean chapter-2-style windowed job: parse -> key -> window sum."""
+    env = env or make_env()
+    (
+        env.from_collection([])
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    return env
+
+
+# ---------------------------------------------------------------------------
+# plan-lint rules: broken job -> exact code; clean job -> silent
+# ---------------------------------------------------------------------------
+
+
+def test_clean_job_has_no_findings():
+    env = good_job()
+    findings = env.analyze()
+    assert not has_errors(findings)
+    assert findings == []
+
+
+def test_tsm001_stateful_without_key_by():
+    env = make_env()
+    stream = env.from_collection([]).map(parse)
+    # cast past the type surface: a rolling max with NO key_by upstream
+    KeyedStream(env, stream.node).max(2).print()
+    found = env.analyze()
+    assert "TSM001" in codes(found)
+    # the targeted ERROR explains the failure; the planner catch-all
+    # (TSM014) must NOT pile on
+    assert "TSM014" not in codes(found)
+
+
+def test_tsm002_event_time_window_without_assigner():
+    env = make_env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    (
+        env.from_collection([])
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    found = env.analyze()
+    assert "TSM002" in codes(found)
+    assert next(f for f in found if f.code == "TSM002").severity == ERROR
+
+
+def test_tsm003_side_output_tag_collision():
+    env = make_env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    tag = OutputTag("late")
+    text = env.from_collection([]).map(parse)
+    for _ in range(2):
+        (
+            text.key_by(0)
+            .time_window(Time.seconds(5))
+            .allowed_lateness(Time.seconds(1))
+            .side_output_late_data(tag)
+            .sum(2)
+            .print()
+        )
+    assert "TSM003" in codes(env.analyze())
+
+
+def test_tsm004_timeout_tag_without_within():
+    env = make_env()
+    pattern = Pattern.begin("a").where(lambda r: r.f2 > 0).times(2)
+    keyed = env.from_collection([]).map(parse).key_by(0)
+    alerts = CEP.pattern(keyed, pattern).select(
+        lambda m: m["a"][0], timeout_tag=OutputTag("to")
+    )
+    alerts.print()
+    alerts.get_side_output(OutputTag("to")).print()
+    assert "TSM004" in codes(env.analyze())
+
+
+def test_tsm004_lateness_on_processing_time():
+    env = make_env()  # ProcessingTime default
+    (
+        env.from_collection([])
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .allowed_lateness(Time.seconds(2))
+        .sum(2)
+        .print()
+    )
+    f = next(f for f in env.analyze() if f.code == "TSM004")
+    assert "processing-time" in f.message
+
+
+def test_tsm005_nonreplayable_source_under_restart():
+    env = make_env(restart_strategy=fixed_delay(3))
+    # socket source constructs lazily: no connection until execute()
+    text = env.socket_text_stream("localhost", 19999)
+    text.map(parse).filter(lambda v: v.f2 > 90).print()
+    f = next(f for f in env.analyze() if f.code == "TSM005")
+    assert f.severity == ERROR
+    assert "SocketTextSource" in f.message
+
+
+def test_tsm005_silent_for_replayable_source():
+    env = good_job(make_env(restart_strategy=fixed_delay(3)))
+    assert "TSM005" not in codes(env.analyze())
+
+
+def test_tsm006_compaction_on_mesh():
+    # explicit capacity on p>1: WARN
+    env = good_job(make_env(parallelism=2, compaction_capacity=128))
+    f = next(f for f in env.analyze() if f.code == "TSM006")
+    assert f.severity == WARN
+    # default capacity: same fact, INFO (nothing was asked for)
+    env = good_job(make_env(parallelism=2))
+    f = next(f for f in env.analyze() if f.code == "TSM006")
+    assert f.severity == INFO
+    # single chip: silent
+    env = good_job(make_env(compaction_capacity=128))
+    assert "TSM006" not in codes(env.analyze())
+
+
+def test_tsm008_tenant_chain_drift():
+    from tpustream.jobs.chapter6_tenant_fleet import make_fleet, make_rules
+
+    server = make_fleet({"t0": 90.0})
+    env = StreamExecutionEnvironment(server.config)
+    server.build_job(env)
+    assert "TSM008" not in codes(env.analyze())  # honest fleet: clean
+
+    # swap the fleet template out from under the built chain
+    from tpustream.tenancy import TenantPlan
+
+    server.plan = TenantPlan(
+        parse=lambda s: s,
+        build=lambda stream, rules: stream.filter(lambda v: True).map(
+            lambda v: v
+        ),
+        rules=make_rules(),
+    )
+    f = next(f for f in env.analyze() if f.code == "TSM008")
+    assert f.severity == ERROR
+
+
+def test_tsm009_fetch_group_exceeds_window():
+    env = good_job(make_env(async_depth=2, fetch_group=4))
+    assert "TSM009" in codes(env.analyze())
+    env = good_job(make_env(async_depth=4, fetch_group=2))
+    assert "TSM009" not in codes(env.analyze())
+
+
+def test_tsm010_window_process_forces_depth_one():
+    env = make_env(async_depth=2)
+    (
+        env.from_collection([])
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .process(lambda key, ctx, elems: [])
+        .print()
+    )
+    f = next(f for f in env.analyze() if f.code == "TSM010")
+    assert f.severity == INFO
+
+
+def test_tsm011_adaptive_bounds():
+    obs = ObsConfig(enabled=True, adaptive=True,
+                    adaptive_bounds={"async_depth": (5, 2)})
+    env = good_job(make_env(obs=obs))
+    f = next(f for f in env.analyze() if f.code == "TSM011")
+    assert f.severity == ERROR
+    # unknown knob names: WARN, not ERROR
+    obs = ObsConfig(enabled=True, adaptive=True,
+                    adaptive_bounds={"warp_factor": (1, 2)})
+    env = good_job(make_env(obs=obs))
+    f = next(f for f in env.analyze() if f.code == "TSM011")
+    assert f.severity == WARN
+
+
+def test_tsm012_grouped_fetch_coarsens_latency():
+    obs = ObsConfig(enabled=True)
+    env = good_job(make_env(obs=obs, async_depth=4, fetch_group=2))
+    f = next(f for f in env.analyze() if f.code == "TSM012")
+    assert f.severity == INFO
+    assert "per-group averages" in f.message
+    # fetch_group=1: silent
+    env = good_job(make_env(obs=ObsConfig(enabled=True)))
+    assert "TSM012" not in codes(env.analyze())
+
+
+def test_tsm013_unproduced_side_output_tag():
+    env = make_env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    out = (
+        env.from_collection([])
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+    )
+    out.print()
+    out.get_side_output(OutputTag("never-declared")).print()
+    f = next(f for f in env.analyze() if f.code == "TSM013")
+    assert f.severity == ERROR
+
+
+def test_tsm014_planner_rejection_catch_all():
+    env = make_env()
+    stream = env.from_collection([])
+    bogus = Node("transmogrify", stream.node, {})
+    env._register_sink(Node("sink_print", bogus, {}))
+    f = next(f for f in env.analyze() if f.code == "TSM014")
+    assert f.severity == ERROR
+    assert "planner" in f.message
+
+
+def test_findings_sorted_errors_first():
+    # one ERROR (TSM013) + one INFO (TSM010) in a single graph
+    env = make_env(async_depth=2)
+    out = (
+        env.from_collection([])
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .process(lambda key, ctx, elems: [])
+    )
+    out.print()
+    out.get_side_output(OutputTag("nope")).print()
+    found = env.analyze()
+    ranks = [{"error": 2, "warn": 1, "info": 0}[f.severity] for f in found]
+    assert ranks == sorted(ranks, reverse=True)
+    assert found[0].severity == ERROR
+
+
+# ---------------------------------------------------------------------------
+# purity analyzer
+# ---------------------------------------------------------------------------
+
+
+def test_tsm020_nondeterministic_call():
+    import random
+
+    def jitter(v):
+        return Tuple3(v.f0, v.f1, v.f2 * random.random())
+
+    env = make_env()
+    env.from_collection([]).map(parse).map(jitter).print()
+    assert "TSM020" in codes(env.analyze())
+
+
+def test_tsm021_mutable_closure():
+    seen = []
+
+    def remember(v):
+        seen.append(v)
+        return v
+
+    found = analyze_callable(remember, "map", device=True)
+    assert codes(found) == ["TSM021"]
+    # immutable capture: silent
+    threshold = 90.0
+    assert analyze_callable(lambda v: v.f2 > threshold, "filter") == []
+
+
+def test_tsm021_global_write():
+    def bump(v):
+        global _BUMP_COUNT
+        _BUMP_COUNT += 1
+        return v
+
+    assert "TSM021" in codes(analyze_callable(bump, "map"))
+
+
+def test_tsm022_print_in_device_fn():
+    def shout(v):
+        print("saw", v)
+        return v
+
+    assert "TSM022" in codes(analyze_callable(shout, "map", device=True))
+    # host stages may print: the device-only rule stays quiet
+    assert "TSM022" not in codes(
+        analyze_callable(shout, "map", device=False)
+    )
+
+
+def test_tsm023_host_callback_in_device_fn():
+    def peek(v):
+        import jax
+
+        jax.debug.print("v={}", v)
+        return v
+
+    found = analyze_callable(peek, "map", device=True)
+    assert "TSM023" in codes(found)
+    assert next(f for f in found if f.code == "TSM023").severity == ERROR
+
+
+def test_tsm024_dtype_widening():
+    widen = lambda v: v * np.float64(2.0)  # noqa: E731
+    found = check_dtype_widening(widen, ["f64"], value_dtype="float32")
+    assert codes(found) == ["TSM024"]
+    # a dtype-preserving map is silent
+    keep = lambda v: v * np.float32(2.0)  # noqa: E731
+    assert check_dtype_widening(keep, ["f64"], value_dtype="float32") == []
+    # at float64 (the default) there is nothing wider to widen to
+    assert check_dtype_widening(widen, ["f64"], value_dtype="float64") == []
+
+
+def test_purity_skips_unreadable_callables():
+    # builtins have no retrievable source: silence, never a crash
+    assert analyze_callable(len, "map", device=True) == []
+
+
+# ---------------------------------------------------------------------------
+# strict mode + obs integration
+# ---------------------------------------------------------------------------
+
+
+def test_strict_analysis_blocks_before_compile():
+    env = make_env(strict_analysis=True)
+    stream = env.from_collection(["1563452051 10.8.22.1 cpu2 99.2"])
+    KeyedStream(env, stream.map(parse).node).max(2).print()
+    with pytest.raises(PlanAnalysisError) as ei:
+        env.execute("broken")
+    assert any(f.code == "TSM001" for f in ei.value.findings)
+    assert "strict_analysis" in str(ei.value)
+    # submission never got far enough to attach metrics
+    assert env.metrics is None
+
+
+def test_strict_analysis_off_by_default_and_warns_pass():
+    env = make_env(strict_analysis=True, async_depth=2, fetch_group=4)
+    text = env.add_source(ReplaySource(["1563452051 10.8.22.1 cpu2 99.2"]))
+    handle = text.map(parse).filter(lambda v: v.f2 > 90).collect()
+    env.execute("warn-only")  # TSM009 is WARN: strict mode still runs
+    assert handle.items == [("10.8.22.1", "cpu2", 99.2)]
+
+
+def test_obs_records_findings_and_clamp():
+    env = make_env(
+        async_depth=2, fetch_group=4, obs=ObsConfig(enabled=True)
+    )
+    text = env.add_source(ReplaySource(["1563452051 10.8.22.1 cpu2 99.2"]))
+    handle = text.map(parse).filter(lambda v: v.f2 > 90).collect()
+    res = env.execute("obs-findings")
+    assert handle.items == [("10.8.22.1", "cpu2", 99.2)]
+    series = {
+        (s["name"], s["labels"].get("code")): s["value"]
+        for s in res.metrics.obs_snapshot()["metrics"]["series"]
+        if s["name"] == "analysis_findings_total"
+    }
+    assert series[("analysis_findings_total", "TSM009")] == 1
+    kinds = [e["kind"] for e in res.metrics.job_obs.flight.events()]
+    assert "analysis_finding" in kinds
+    assert "config_clamped" in kinds
+    clamp = next(
+        e for e in res.metrics.job_obs.flight.events()
+        if e["kind"] == "config_clamped"
+    )
+    assert clamp["knob"] == "fetch_group"
+    assert clamp["effective"] == 1
+
+
+# ---------------------------------------------------------------------------
+# lint CLI
+# ---------------------------------------------------------------------------
+
+
+def test_lint_cli_all_chapters_clean():
+    out = io.StringIO()
+    assert lint_main([], out=out) == 0
+    text = out.getvalue()
+    for ch in (
+        "chapter1_threshold", "chapter2_avg", "chapter2_max",
+        "chapter2_median", "chapter3_bandwidth",
+        "chapter3_bandwidth_eventtime", "chapter4_cep_alert",
+        "chapter5_dynamic_rules", "chapter6_tenant_fleet",
+    ):
+        assert f"tpustream.jobs.{ch}: ok (0 errors" in text
+
+
+def test_lint_cli_exit_codes(tmp_path, monkeypatch):
+    # rc=2: module does not import
+    out = io.StringIO()
+    assert lint_main(["no.such.module"], out=out) == 2
+    assert "IMPORT FAILED" in out.getvalue()
+
+    # rc=1: a job module whose graph has an ERROR finding
+    (tmp_path / "badjob.py").write_text(textwrap.dedent(
+        """
+        from tpustream import StreamExecutionEnvironment
+        from tpustream.api.datastream import KeyedStream
+
+        def lint_env():
+            env = StreamExecutionEnvironment.get_execution_environment()
+            stream = env.from_collection([])
+            KeyedStream(env, stream.node).max(0).print()
+            return env
+        """
+    ))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    out = io.StringIO()
+    assert lint_main(["badjob"], out=out) == 1
+    assert "TSM001" in out.getvalue()
+
+    # no lint_env hook: skipped, rc=0
+    (tmp_path / "hookless.py").write_text("x = 1\n")
+    out = io.StringIO()
+    assert lint_main(["hookless"], out=out) == 0
+    assert "skipped" in out.getvalue()
+
+
+def test_catalog_is_stable():
+    """Codes are append-only API: the documented set must stay intact
+    (docs/analysis.md renders from CATALOG)."""
+    expected = {
+        "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
+        "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
+        "TSM013", "TSM014", "TSM020", "TSM021", "TSM022", "TSM023",
+        "TSM024",
+    }
+    assert expected <= set(CATALOG)
+    for code, rule in CATALOG.items():
+        assert rule.code == code
+        assert rule.severity in (ERROR, WARN, INFO)
+        assert rule.title and rule.rationale and rule.fix_hint

@@ -259,10 +259,12 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 58
+    assert int(m.group(1)) == 60
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     assert "ok: prometheus carries the fleet gauges" in out
+    # the pre-flight analysis counter checks are part of the suite
+    assert "ok: prometheus carries the per-code analysis findings" in out
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,7 @@ from .api.functions import (  # noqa: E402
     ReduceFunction,
 )
 from .api.output import OutputTag  # noqa: E402
+from .analysis import Finding, PlanAnalysisError  # noqa: E402
 from .broadcast import (  # noqa: E402
     BroadcastStream,
     RuleDescriptor,
@@ -65,12 +66,14 @@ __all__ = [
     "BroadcastStream",
     "CEP",
     "FilterFunction",
+    "Finding",
     "JobServer",
     "KeySelector",
     "MapFunction",
     "OutputTag",
     "Pattern",
     "PatternSelectFunction",
+    "PlanAnalysisError",
     "ProcessWindowFunction",
     "ReduceFunction",
     "RestartStrategies",

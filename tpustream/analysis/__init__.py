@@ -1,0 +1,65 @@
+"""Pre-flight static analysis of constructed job graphs.
+
+``analyze(env)`` runs every plan-lint rule (plan_rules) and the
+user-function purity analyzer (purity) over the env's sink graph and
+returns typed :class:`Finding` objects — all before any XLA trace.
+``StreamConfig.strict_analysis=True`` makes the executor call this at
+submission and raise :class:`PlanAnalysisError` on ERROR findings;
+``python -m tpustream.analysis.lint`` is the CLI form. The rule catalog
+lives in :data:`findings.CATALOG` and docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .findings import (
+    CATALOG,
+    ERROR,
+    INFO,
+    WARN,
+    Finding,
+    PlanAnalysisError,
+    Rule,
+    has_errors,
+    make_finding,
+    worst_severity,
+)
+from .plan_rules import AnalysisContext, run_plan_rules
+from .purity import analyze_callable, check_dtype_widening, run_purity_rules
+
+__all__ = [
+    "AnalysisContext",
+    "CATALOG",
+    "ERROR",
+    "Finding",
+    "INFO",
+    "PlanAnalysisError",
+    "Rule",
+    "WARN",
+    "analyze",
+    "analyze_callable",
+    "check_dtype_widening",
+    "has_errors",
+    "make_finding",
+    "worst_severity",
+]
+
+
+def analyze(env, sink_nodes=None) -> List[Finding]:
+    """All findings for the env's constructed job graph, ERROR first.
+
+    Pure inspection: walks Node chains, config, broadcast rules, and
+    the tenancy template. Safe to call any number of times; the graph
+    is never mutated and nothing compiles.
+    """
+    from .findings import severity_rank
+
+    if sink_nodes is None:
+        sink_nodes = getattr(env, "_sinks", [])
+    if not sink_nodes:
+        return []
+    ctx = AnalysisContext(env, sink_nodes)
+    findings = run_plan_rules(ctx) + run_purity_rules(ctx)
+    findings.sort(key=lambda f: (-severity_rank(f.severity), f.code))
+    return findings

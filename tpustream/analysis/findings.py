@@ -1,0 +1,258 @@
+"""Typed findings for the pre-flight plan analyzer.
+
+A finding is a static diagnosis of a constructed job graph, produced
+BEFORE any XLA trace: a stable ``TSM0xx`` code, a severity, the node it
+anchors to, and a fix hint. The catalog below is the single source of
+truth for codes — docs/analysis.md renders from the same entries, and
+tests assert codes, not message text.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+
+#: ordering for "worst finding" comparisons
+_SEVERITY_RANK = {ERROR: 2, WARN: 1, INFO: 0}
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str                    # stable TSM0xx identifier
+    severity: str                # ERROR | WARN | INFO
+    node: Optional[Any]          # the graph Node (or None for config findings)
+    message: str
+    fix_hint: str = ""
+
+    def __str__(self) -> str:  # CLI / log line form
+        where = f" at {self.node!r}" if self.node is not None else ""
+        hint = f" [fix: {self.fix_hint}]" if self.fix_hint else ""
+        return f"{self.code} {self.severity.upper()}{where}: {self.message}{hint}"
+
+
+class PlanAnalysisError(RuntimeError):
+    """Raised pre-compile under ``StreamConfig.strict_analysis`` when the
+    analyzer reports any ERROR finding. Carries the full finding list."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = list(findings)
+        errors = [f for f in self.findings if f.severity == ERROR]
+        lines = "\n".join(f"  {f}" for f in errors)
+        super().__init__(
+            f"plan analysis found {len(errors)} error finding(s) "
+            f"(strict_analysis=True blocks compilation):\n{lines}"
+        )
+
+
+def severity_rank(sev: str) -> int:
+    return _SEVERITY_RANK.get(sev, -1)
+
+
+def worst_severity(findings) -> Optional[str]:
+    worst = None
+    for f in findings:
+        if worst is None or severity_rank(f.severity) > severity_rank(worst):
+            worst = f.severity
+    return worst
+
+
+def has_errors(findings) -> bool:
+    return any(f.severity == ERROR for f in findings)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """Catalog entry: one stable code with its default severity and the
+    invariant it guards (docs/analysis.md is generated from these)."""
+
+    code: str
+    severity: str
+    title: str
+    rationale: str
+    fix_hint: str
+
+
+#: the full rule catalog, keyed by code. Codes are append-only: a rule
+#: that stops firing keeps its number (like E999 in linters), so saved
+#: baselines and suppression lists stay meaningful across versions.
+CATALOG = {
+    r.code: r
+    for r in [
+        Rule(
+            "TSM001", ERROR, "keyed-state operator without key_by",
+            "rolling aggregates, windows, and CEP allocate per-key HBM "
+            "state; without an upstream key_by there is no key to route "
+            "records by and planning fails at trace time.",
+            "insert .key_by(field) before the stateful operator",
+        ),
+        Rule(
+            "TSM002", ERROR, "event-time operator without a timestamp assigner",
+            "an event-time window or within()-bounded CEP pattern never "
+            "fires if no operator assigns event timestamps and watermarks "
+            "(records carry ts=0, the watermark never advances).",
+            "call assign_timestamps_and_watermarks(...) before the first "
+            "parse map, or switch to ProcessingTime",
+        ),
+        Rule(
+            "TSM003", ERROR, "side-output tag collision",
+            "two different producers emit under one OutputTag id; "
+            "get_side_output would silently interleave late data with "
+            "CEP timeouts (or another stream's records).",
+            "give each side output a distinct OutputTag id",
+        ),
+        Rule(
+            "TSM004", WARN, "lateness / within() misconfiguration",
+            "allowed_lateness under ProcessingTime never admits a late "
+            "record (processing time has no late data); a CEP timeout_tag "
+            "without within() never receives a timeout; lateness without "
+            "a late tag silently drops post-fire records.",
+            "match the lateness/timeout configuration to the time domain "
+            "and pattern bounds",
+        ),
+        Rule(
+            "TSM005", ERROR, "non-replayable source under a restart strategy",
+            "supervised restarts resume by replaying the source from the "
+            "last checkpoint; a socket or one-shot iterator source cannot "
+            "seek back, so recovery would silently lose records.",
+            "use a replayable source (from_collection / ReplaySource) or "
+            "drop the restart strategy",
+        ),
+        Rule(
+            "TSM006", WARN, "output compaction requested on a multi-chip mesh",
+            "compaction_capacity only compiles a compaction stage on a "
+            "single chip: the sharded compact gather's per-step all-gather "
+            "rendezvous dwarfs the fetch saving, so the runtime keeps the "
+            "full fetch path and the knob silently does nothing.",
+            "leave compaction_capacity at default on p>1 meshes, or run "
+            "single-chip for wire-bound jobs",
+        ),
+        Rule(
+            "TSM007", INFO, "rule leaves rely on forced replication",
+            "[T] per-tenant rule vectors have ndim 1; shape-based spec "
+            "inference would shard them across the mesh and a per-record "
+            "gather would read another shard's slice. The runtime pins "
+            "rule leaves to PartitionSpec() — this finding documents that "
+            "the plan depends on that forced replication.",
+            "keep rule leaves replicated; do not add rule leaves to "
+            "sharded state specs",
+        ),
+        Rule(
+            "TSM008", ERROR, "tenant chain diverges from the fleet template",
+            "a multi-tenant job's operator chain must match its "
+            "TenantPlan signature exactly — the fleet shares ONE compiled "
+            "program, and a drifted chain corrupts shared keyed state.",
+            "rebuild the job through JobServer.build_job / TenantPlan."
+            "verify, changing only rule parameters per tenant",
+        ),
+        Rule(
+            "TSM009", WARN, "fetch_group exceeds the in-flight window",
+            "a fetch group equal to the full async_depth window drains "
+            "the pipeline empty on every grouped fetch, serializing "
+            "dispatch against the round trip it was meant to amortize; "
+            "the executor clamps the effective group to async_depth - 1.",
+            "raise async_depth alongside fetch_group (the effective "
+            "group is clamped to async_depth - 1)",
+        ),
+        Rule(
+            "TSM010", INFO, "pipeline depth forced synchronous",
+            "full-window process() emissions reference live device state "
+            "and max_fires_per_step paces the step loop — either forces "
+            "async_depth/h2d_depth to 1, so configured depths above 1 "
+            "buy nothing for this plan.",
+            "expect synchronous stepping, or restructure the window apply "
+            "as reduce/aggregate to regain overlap",
+        ),
+        Rule(
+            "TSM011", ERROR, "adaptive controller misconfiguration",
+            "an adaptive_bounds entry with lo > hi (or lo < 1) can never "
+            "admit a legal knob value; unknown knob names are silently "
+            "ignored; the controller needs live obs to read rate history.",
+            "fix the (lo, hi) bounds, name only async_depth/fetch_group/"
+            "h2d_depth, and enable obs when adaptive=True",
+        ),
+        Rule(
+            "TSM012", INFO, "grouped fetch coarsens step_ms_p90",
+            "with fetch_group > 1 the blocking wait of one grouped fetch "
+            "is divided evenly over its G steps, so step_times_s (and the "
+            "step_ms_p90 summary) report per-group averages, not true "
+            "per-step latencies — tails are smoothed by up to G×.",
+            "interpret step_ms_p90 as a per-group average, or set "
+            "fetch_group=1 when profiling per-step tails",
+        ),
+        Rule(
+            "TSM013", ERROR, "side output reads a tag its stream never emits",
+            "get_side_output(tag) on an operator whose window/CEP "
+            "declares no matching late_tag/timeout_tag yields a stream "
+            "that is silently empty forever.",
+            "pass the tag to side_output_late_data(...) / select("
+            "timeout_tag=...) on the producing operator",
+        ),
+        Rule(
+            "TSM014", ERROR, "graph does not plan",
+            "the planner rejects this operator chain outright (the "
+            "attached message is the planner's own diagnosis).",
+            "restructure the chain per the planner message",
+        ),
+        Rule(
+            "TSM020", WARN, "nondeterministic call in a user function",
+            "time/random/datetime/uuid calls make replay diverge: a "
+            "supervised restart reprocesses records from the last "
+            "checkpoint and would compute different values the second "
+            "time, breaking exactly-once output.",
+            "derive values from record fields and event time; pass seeds "
+            "or clocks in as data",
+        ),
+        Rule(
+            "TSM021", WARN, "user function captures mutable state",
+            "a closure over a list/dict/set (or a global/nonlocal write) "
+            "is traced ONCE and vmapped — per-record mutation silently "
+            "does not happen per record, and restarts reset it.",
+            "move evolving values into keyed state (reduce/aggregate) or "
+            "broadcast rules",
+        ),
+        Rule(
+            "TSM022", WARN, "Python side effect in a device function",
+            "print/open/logging inside a traced map/filter/predicate "
+            "runs at TRACE time only (once), not per record — the "
+            "side effect will appear to fire exactly once and never again.",
+            "side-effect in a sink or host stage; use debug breadcrumbs "
+            "via the obs layer",
+        ),
+        Rule(
+            "TSM023", ERROR, "host callback inside a device function",
+            "jax host callbacks (pure_callback/io_callback/debug.*) "
+            "inside the fused step program stall the device on a host "
+            "round trip per batch and break the multi-chip collective "
+            "schedule.",
+            "do host work in the host parse stage or a sink, not inside "
+            "device maps/filters/predicates",
+        ),
+        Rule(
+            "TSM024", WARN, "user function widens the value dtype",
+            "a map returning a wider float than value_dtype re-traces "
+            "the step program with new avals — one recompile, plus "
+            "doubled wire bytes for every downstream column.",
+            "cast back to the configured value_dtype inside the map, or "
+            "widen value_dtype deliberately",
+        ),
+    ]
+}
+
+
+def make_finding(code: str, node=None, message: str = "",
+                 severity: Optional[str] = None) -> Finding:
+    """A Finding for a cataloged code; message defaults to the catalog
+    title, severity to the catalog severity (rules may override, e.g.
+    TSM006 downgrades to INFO at the default capacity)."""
+    rule = CATALOG[code]
+    return Finding(
+        code=code,
+        severity=severity or rule.severity,
+        node=node,
+        message=message or rule.title,
+        fix_hint=rule.fix_hint,
+    )

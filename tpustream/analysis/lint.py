@@ -1,0 +1,84 @@
+"""``python -m tpustream.analysis.lint`` — job-module lint CLI.
+
+Imports job modules (``tpustream.jobs.chapter*`` by default, or any
+module path given on the command line), asks each for its lintable env
+via the module's ``lint_env()`` hook, runs :func:`tpustream.analysis
+.analyze`, and prints findings. Exit status: 0 = no ERROR findings,
+1 = at least one ERROR, 2 = a module could not be imported/linted.
+
+Job modules opt in by defining ``lint_env() -> StreamExecutionEnvironment``
+returning a CONSTRUCTED (never executed) env — typically the module's
+``build`` over a tiny ``from_collection`` source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import pkgutil
+import sys
+from typing import List, Optional
+
+from . import analyze
+from .findings import ERROR, WARN
+
+
+def discover_job_modules() -> List[str]:
+    from .. import jobs
+
+    return sorted(
+        f"tpustream.jobs.{m.name}"
+        for m in pkgutil.iter_modules(jobs.__path__)
+        if m.name.startswith("chapter")
+    )
+
+
+def lint_module(name: str, out=sys.stdout) -> int:
+    """Lint one module; returns its exit status (0/1/2)."""
+    try:
+        mod = importlib.import_module(name)
+    except Exception as e:
+        print(f"{name}: IMPORT FAILED: {e}", file=out)
+        return 2
+    hook = getattr(mod, "lint_env", None)
+    if hook is None:
+        print(f"{name}: no lint_env() hook — skipped", file=out)
+        return 0
+    try:
+        env = hook()
+        findings = analyze(env)
+    except Exception as e:
+        print(f"{name}: LINT FAILED: {type(e).__name__}: {e}", file=out)
+        return 2
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warns = sum(1 for f in findings if f.severity == WARN)
+    status = "FAIL" if errors else "ok"
+    print(
+        f"{name}: {status} ({errors} errors, {warns} warnings, "
+        f"{len(findings)} findings)",
+        file=out,
+    )
+    for f in findings:
+        print(f"  {f}", file=out)
+    return 1 if errors else 0
+
+
+def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpustream.analysis.lint",
+        description="pre-flight static analysis of tpustream job modules",
+    )
+    parser.add_argument(
+        "modules", nargs="*",
+        help="job module paths (default: every tpustream.jobs.chapter*)",
+    )
+    args = parser.parse_args(argv)
+    modules = args.modules or discover_job_modules()
+    rc = 0
+    for name in modules:
+        rc = max(rc, lint_module(name, out=out))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,16 @@ class StreamExecutionEnvironment:
             )
         self._broadcast = bs
 
+    def analyze(self) -> list:
+        """Pre-flight static analysis of the constructed graph: every
+        plan-lint and purity finding (tpustream/analysis), worst first.
+        Pure inspection — nothing plans, traces, or compiles, and the
+        graph is not mutated. ``execute()`` runs the same analysis
+        automatically when ``config.strict_analysis`` or obs is on."""
+        from ..analysis import analyze
+
+        return analyze(self, self._sinks)
+
     def execute(self, job_name: str = "tpustream job"):
         """Phase B: plan, compile, and run the job to source exhaustion.
 

@@ -315,6 +315,15 @@ class StreamConfig:
     # fetch saving, and multi-host fetch needs the per-process dense
     # buffers for the chain merge — both keep the full path.
 
+    # -- pre-flight analysis (tpustream/analysis) ---------------------------
+    strict_analysis: bool = False
+    # True: execute() runs the static plan analyzer BEFORE planning or
+    # compiling anything, and any ERROR finding raises PlanAnalysisError
+    # (the job never traces). False (default): analysis still runs when
+    # obs is enabled — findings become flight breadcrumbs and
+    # analysis_findings_total{code=...} counters — but never blocks.
+    # docs/analysis.md catalogs the TSM0xx rules.
+
     # -- observability ------------------------------------------------------
     obs: ObsConfig = field(default_factory=ObsConfig)
 
@@ -329,3 +338,33 @@ class StreamConfig:
         import dataclasses
 
         return dataclasses.replace(self, **kw)
+
+    def resolve(self) -> "tuple[StreamConfig, list]":
+        """Effective-config resolution: cross-knob constraints applied
+        once, at submission, instead of silently at runtime.
+
+        Returns ``(resolved_cfg, notes)`` where each note is a dict
+        ``{knob, requested, effective, reason}``; the executor records
+        one ``config_clamped`` flight breadcrumb per note. Currently one
+        constraint: ``fetch_group`` is clamped to ``async_depth - 1``
+        (at least 1) — a group spanning the full in-flight window would
+        drain the pipeline empty on every grouped fetch, serializing
+        dispatch against the round trip it exists to amortize (ADVICE
+        r5). The runtime keeps its live per-step clamp too (the
+        adaptive controller can move async_depth under a running job).
+        """
+        notes: list = []
+        limit = max(1, self.async_depth - 1)
+        eff = max(1, min(self.fetch_group, limit))
+        cfg = self
+        if eff != self.fetch_group:
+            notes.append({
+                "knob": "fetch_group",
+                "requested": self.fetch_group,
+                "effective": eff,
+                "reason": f"clamped to async_depth-1={limit}: a "
+                          "full-window fetch group drains the pipeline "
+                          "on every grouped fetch",
+            })
+            cfg = self.replace(fetch_group=eff)
+        return cfg, notes

@@ -59,6 +59,14 @@ def health_rules(stall_s: float = 30.0):
     )
 
 
+def lint_env() -> StreamExecutionEnvironment:
+    """Constructed-but-never-executed env for the pre-flight analyzer
+    (``python -m tpustream.analysis.lint``)."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    build(env, env.from_collection([])).print()
+    return env
+
+
 def main(host: str = "localhost", port: int = 8080) -> None:
     env = StreamExecutionEnvironment.get_execution_environment()
     if env.config.obs.enabled and not env.config.obs.health_rules:

@@ -45,6 +45,13 @@ def build(env: StreamExecutionEnvironment, text):
     )
 
 
+def lint_env() -> StreamExecutionEnvironment:
+    """Constructed-but-never-executed env for the pre-flight analyzer."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    build(env, env.from_collection([])).print()
+    return env
+
+
 def main(host: str = "localhost", port: int = 8080) -> None:
     env = StreamExecutionEnvironment.get_execution_environment()
     text = env.socket_text_stream(host, port)

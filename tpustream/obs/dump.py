@@ -432,6 +432,10 @@ def _selftest() -> int:
     tg.counter("tenant_records_total").set_total(512)
     tg.counter("tenant_quota_exceeded_total").set_total(3)
     tg.gauge("tenant_rule_version").set(4)
+    # pre-flight analysis series (docs/analysis.md): per-code finding
+    # counters the executor mints when the analyzer reports
+    g.group(code="TSM009").counter("analysis_findings_total").inc()
+    g.group(code="TSM012").counter("analysis_findings_total").inc()
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -565,6 +569,12 @@ def _selftest() -> int:
         ("prometheus carries the fleet gauges",
          'tenant_count{job="selftest"} 2' in prom
          and 'tenant_rule_version{job="selftest",tenant="acme"} 4'
+         in prom),
+        ("render names the analysis findings counter",
+         "analysis_findings_total" in text),
+        ("prometheus carries the per-code analysis findings",
+         'analysis_findings_total{code="TSM009",job="selftest"} 1' in prom
+         and 'analysis_findings_total{code="TSM012",job="selftest"} 1'
          in prom),
     ]
     checks.extend(_selftest_timeseries())

@@ -11,6 +11,7 @@ exchange, chapter2/.../ComputeCpuMax.java:26, becomes ``id % shards``).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -92,6 +93,16 @@ class DerivedKeyTable(StringTable):
     def __init__(self) -> None:
         super().__init__()
         self._originals: List = [None]
+        # serializes the two-list append in intern_value against
+        # state_dict's snapshot: the parse-ahead thread interns while a
+        # checkpoint captures. The capture-then-truncate ordering below
+        # already yields a consistent prefix on its own; the lock closes
+        # the residual window where an intern lands BETWEEN the two list
+        # appends, so a snapshot is now exact, not just prefix-safe.
+        # Cost: one uncontended lock per DERIVED-key intern (already a
+        # per-record host path doing dict+format work) — invisible next
+        # to the canonical-string formatting.
+        self._mutex = threading.Lock()
         pid = self.intern("\x00reserved:placeholder")
         assert pid == self.PLACEHOLDER_ID
 
@@ -105,9 +116,13 @@ class DerivedKeyTable(StringTable):
                 f"a computed KeySelector must return str/int/float/bool, "
                 f"got {type(v).__name__}: {v!r}"
             )
-        i = self.intern(f"{type(v).__name__}:{v!r}")
-        if i == len(self._originals):
-            self._originals.append(v)
+        with self._mutex:
+            i = self.intern(f"{type(v).__name__}:{v!r}")
+            # self-heal: a canonical string present without its original
+            # (a torn legacy snapshot restored, see load_state_dict)
+            # re-pairs here on first replay of the value
+            if i == len(self._originals):
+                self._originals.append(v)
         return i
 
     def intern_values(self, values) -> np.ndarray:
@@ -120,22 +135,27 @@ class DerivedKeyTable(StringTable):
         return self._originals[i]
 
     def state_dict(self) -> dict:
-        # capture-then-truncate: the parse-ahead thread may be interning
-        # while a checkpoint snapshots this table. intern_value appends to
-        # _to_str (via intern) BEFORE _originals, so at every instant
-        # len(_to_str) >= len(_originals) and the first len(_originals)
-        # entries of both lists are final. Copying _originals FIRST and
-        # truncating the _to_str copy to its length therefore yields a
-        # consistent prefix snapshot without a lock; copying in the other
-        # order could pair a new string with a missing original (a torn
-        # table that restores with misaligned key ids).
-        originals = list(self._originals)
-        strings = list(self._to_str)[: len(originals)]
+        # capture-then-truncate UNDER the intern mutex: the parse-ahead
+        # thread may be interning while a checkpoint snapshots this
+        # table. intern_value appends to _to_str (via intern) BEFORE
+        # _originals, so even without the lock copying _originals FIRST
+        # and truncating the _to_str copy to its length yields a
+        # consistent prefix; the lock (shared with intern_value) makes
+        # the snapshot exact — both lists at one logical length, never
+        # a string whose original is still in flight.
+        with self._mutex:
+            originals = list(self._originals)
+            strings = list(self._to_str)[: len(originals)]
         return {"strings": strings, "originals": originals}
 
     def load_state_dict(self, state: dict) -> None:
-        super().load_state_dict(state)
-        self._originals = list(state.get("originals", []))
+        # accepts torn legacy snapshots (strings longer than originals,
+        # written by a pre-lock build mid-intern): the surplus strings
+        # keep their ids and re-pair with their originals through the
+        # intern_value self-heal on first replay
+        with self._mutex:
+            super().load_state_dict(state)
+            self._originals = list(state.get("originals", []))
 
 
 @dataclass

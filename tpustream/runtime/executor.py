@@ -2366,6 +2366,19 @@ def execute_job(env, sink_nodes) -> JobResult:
     restarts, and a restart rebuilds the chain and resumes exactly-once
     from the latest valid checkpoint. Unset (the default), the first
     failure propagates exactly as before supervision existed."""
+    # pre-flight static analysis (tpustream/analysis): runs ONCE per
+    # submission, before supervision, planning, or any XLA trace. Under
+    # strict_analysis an ERROR finding aborts the job here; otherwise
+    # (obs on) the findings stash on the env and the first attempt's
+    # _execute_job turns them into counters + flight breadcrumbs.
+    if getattr(env.config, "strict_analysis", False) or env.config.obs.enabled:
+        from ..analysis import PlanAnalysisError, analyze, has_errors
+
+        findings = analyze(env, sink_nodes)
+        if findings:
+            env._analysis_findings = findings
+        if getattr(env.config, "strict_analysis", False) and has_errors(findings):
+            raise PlanAnalysisError(findings)
     if getattr(env.config, "restart_strategy", None) is not None:
         from .supervisor import supervise
 
@@ -2399,7 +2412,9 @@ def _run_attempt(env, sink_nodes) -> JobResult:
 
 
 def _execute_job(env, sink_nodes) -> JobResult:
-    cfg = env.config
+    # effective-config resolution (StreamConfig.resolve): cross-knob
+    # clamps applied once here; env.config keeps the requested values
+    cfg, resolve_notes = env.config.resolve()
     plans = build_plan_chain(env, sink_nodes)
     plan = plans[0]
     chained = len(plans) > 1
@@ -2431,6 +2446,27 @@ def _execute_job(env, sink_nodes) -> JobResult:
     else:
         metrics = Metrics()
         job_obs = metrics.job_obs  # the null twin
+    # one breadcrumb per resolution clamp (every attempt: the resolved
+    # knobs are part of this attempt's story, like config_resolved)
+    for note in resolve_notes:
+        job_obs.flight.record("config_clamped", **note)
+    # pre-flight analysis findings (stashed by execute_job; popped so a
+    # supervised restart doesn't double-count): WARN/ERROR go to the
+    # flight ring, every finding increments the per-code counter
+    pending_findings = env.__dict__.pop("_analysis_findings", None)
+    if pending_findings and job_obs.enabled:
+        for f in pending_findings:
+            job_obs.group.group(code=f.code).counter(
+                "analysis_findings_total"
+            ).inc()
+            if f.severity in ("error", "warn"):
+                job_obs.flight.record(
+                    "analysis_finding",
+                    code=f.code,
+                    severity=f.severity,
+                    node=repr(f.node) if f.node is not None else None,
+                    message=f.message,
+                )
     # adaptive pipeline controller (runtime/controller.py): opt-in
     # closed-loop tuning of the barrier-safe overlap depths at snapshot
     # ticks. Requires live obs (it reads the registry's series history)
