@@ -546,6 +546,67 @@ def multitenancy_probe(tenant_counts=(1, 16, 64, 256),
     )
 
 
+def tenant_slo_probe(tenants=64, records_per_tenant=16, flood_factor=20,
+                     batch_size=256):
+    """Phase T, SLO leg: noisy-neighbor attribution
+    (docs/multitenancy.md). One fleet with a per-tenant SLO on every
+    tenant; ``t000`` floods ``flood_factor``x its quota. Reports the
+    flooder's attributed error rate, its compiled SLO verdict and
+    budget burn, how many OTHER tenants stayed OK on their own series
+    (the isolation proof), and what one ``/tenants.json`` fleet view
+    costs to assemble."""
+    import time as _time
+
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs import chapter6_tenant_fleet as c6
+    from tpustream.obs.slo import TenantSLO
+
+    thresholds = {f"t{i:03d}": 80.0 + (i % 20) for i in range(tenants)}
+    srv = c6.make_fleet(
+        thresholds,
+        quotas={"t000": records_per_tenant},
+        tenant_capacity=tenants,
+        config=StreamConfig(
+            batch_size=batch_size, obs=ObsConfig(enabled=True)
+        ),
+    )
+    slo = TenantSLO(p99_ms=1e6, max_error_rate=0.01, budget_window_s=60.0)
+    for t in thresholds:
+        srv.set_tenant_slo(t, slo)
+    offered = 0
+    for t in thresholds:
+        n = records_per_tenant * (flood_factor if t == "t000" else 1)
+        srv.ingest(t, c6.tenant_lines(t, n))
+        offered += n
+    t0 = _time.perf_counter()
+    srv.run(f"fleet-slo-{tenants}")
+    wall_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    view = srv.tenants_snapshot()
+    scrape_ms = (_time.perf_counter() - t0) * 1000.0
+    flood = view["tenants"]["t000"]
+    verdict = flood["health"]["slo_err[t000]"]
+    others_ok = sum(
+        1 for t, e in view["tenants"].items()
+        if t != "t000"
+        and all(r["level"] == "ok" for r in e.get("health", {}).values())
+    )
+    latency_series = sum(
+        1 for e in view["tenants"].values() if "e2e_p99_ms" in e
+    )
+    return dict(
+        tenants=tenants,
+        flood_factor=flood_factor,
+        events_per_s=round(offered / wall_s) if wall_s else None,
+        flooder_error_rate=round(flood["error_rate"], 4),
+        flooder_level=verdict["level"],
+        flooder_budget_burn=verdict["budget_burn"],
+        others_ok=others_ok,
+        tenants_with_latency_series=latency_series,
+        tenants_json_scrape_ms=round(scrape_ms, 3),
+    )
+
+
 def sustainable_rate(run_paced, r0, label, rtt_ms):
     """Rate -> p99 curve with stage attribution (VERDICT r4 next #1),
     walking a descending rate ladder from the flood throughput ``r0``.
@@ -2073,6 +2134,23 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase T skipped: {e}")
 
+    # ---- Phase T, SLO leg: noisy-neighbor attribution -------------------
+    tenant_slo = None
+    try:
+        tenant_slo = tenant_slo_probe()
+        log(
+            f"phase T slo: {tenant_slo['tenants']}-tenant fleet, one "
+            f"tenant flooding {tenant_slo['flood_factor']}x quota: "
+            f"flooder error rate {tenant_slo['flooder_error_rate']} -> "
+            f"{tenant_slo['flooder_level']} (budget burn "
+            f"{tenant_slo['flooder_budget_burn']}), "
+            f"{tenant_slo['others_ok']} other tenants OK; "
+            f"/tenants.json view in "
+            f"{tenant_slo['tenants_json_scrape_ms']} ms"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase T slo skipped: {e}")
+
     print(
         json.dumps(
             {
@@ -2171,6 +2249,10 @@ def main():
                     # tenant count, with the per-fleet zero-recompile
                     # proof (docs/multitenancy.md)
                     "multitenancy": multitenancy,
+                    # phase T SLO leg: per-tenant SLO verdicts under one
+                    # flooding tenant — noisy-neighbor attribution and
+                    # the isolation proof (docs/multitenancy.md)
+                    "tenant_slo": tenant_slo,
                     # and its device-side registries, folded: what XLA
                     # built (count/cause/wall/cost) and what the state
                     # pytree costs in HBM per operator/component

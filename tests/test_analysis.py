@@ -210,6 +210,41 @@ def test_tsm008_tenant_chain_drift():
     assert f.severity == ERROR
 
 
+def test_tsm008_tolerates_leading_flat_map():
+    """A fleet template that leads with flat_map lowers it onto the raw
+    host stage BEFORE the lazily attached parse map: the template check
+    must fold those leading nodes back into the signature, not skip (or
+    flag) the chain."""
+    from tpustream import JobServer, TenantPlan
+    from tpustream.jobs.chapter6_tenant_fleet import make_rules
+    from tpustream.jobs.chapter6_tenant_fleet import parse as c6_parse
+
+    plan = TenantPlan(
+        parse=c6_parse,
+        build=lambda s, r: s.flat_map(lambda line: line.split("|")).filter(
+            lambda v: v.f2 > r.param("threshold")
+        ),
+        rules=make_rules(),
+        tenant_capacity=4,
+    )
+    server = JobServer(plan, config=StreamConfig())
+    server.add_tenant("t0", rules={"threshold": 90.0})
+    env = StreamExecutionEnvironment(server.config)
+    server.build_job(env)
+    assert "TSM008" not in codes(env.analyze())
+
+    # drift UNDER the flat_map prefix is still caught
+    server.plan = TenantPlan(
+        parse=c6_parse,
+        build=lambda s, r: s.flat_map(lambda line: line.split("|")).map(
+            lambda v: v
+        ),
+        rules=make_rules(),
+        tenant_capacity=4,
+    )
+    assert "TSM008" in codes(env.analyze())
+
+
 def test_tsm009_fetch_group_exceeds_window():
     env = good_job(make_env(async_depth=2, fetch_group=4))
     assert "TSM009" in codes(env.analyze())
@@ -280,6 +315,51 @@ def test_tsm014_planner_rejection_catch_all():
     f = next(f for f in env.analyze() if f.code == "TSM014")
     assert f.severity == ERROR
     assert "planner" in f.message
+
+
+def test_tsm015_health_rule_unknown_series():
+    from tpustream.obs.health import AlertRule
+
+    bad = AlertRule(name="typo", metric="step_tme_s:p99", value=1.0)
+    obs = ObsConfig(enabled=True, health_rules=(bad,))
+    env = good_job(make_env(obs=obs))
+    f = next(f for f in env.analyze() if f.code == "TSM015")
+    assert f.severity == WARN
+    assert "step_tme_s" in f.message
+    # dict-form rules are coerced the same way
+    obs = ObsConfig(
+        enabled=True,
+        health_rules=({"name": "d", "metric": "no_such_series"},),
+    )
+    env = good_job(make_env(obs=obs))
+    assert "TSM015" in codes(env.analyze())
+
+
+def test_tsm015_known_series_and_patterns_are_clean():
+    from tpustream.obs.health import AlertRule
+
+    good_rules = (
+        AlertRule(name="slow", metric="step_time_s:p99", value=0.5),
+        AlertRule(name="sink", metric="sink0_e2e_latency_ms:p99", value=9.0),
+        AlertRule(name="op", metric="operator_window_steps", kind="absence"),
+        AlertRule(name="ts", metric="tenant_step_share", value=0.8),
+    )
+    obs = ObsConfig(enabled=True, health_rules=good_rules)
+    env = good_job(make_env(obs=obs))
+    assert "TSM015" not in codes(env.analyze())
+
+
+def test_tsm015_tenant_slo_series_are_cataloged():
+    """The series compile_tenant_slo emits must stay in the catalog —
+    this is the drift guard for the per-tenant SLO engine."""
+    from tpustream.jobs.chapter6_tenant_fleet import make_fleet
+    from tpustream.obs.slo import TenantSLO
+
+    server = make_fleet({"t0": 90.0})
+    server.set_tenant_slo("t0", TenantSLO(p99_ms=50.0, max_error_rate=0.01))
+    env = StreamExecutionEnvironment(server.config)
+    server.build_job(env)
+    assert "TSM015" not in codes(env.analyze())
 
 
 def test_findings_sorted_errors_first():
@@ -484,8 +564,8 @@ def test_catalog_is_stable():
     expected = {
         "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
         "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
-        "TSM013", "TSM014", "TSM020", "TSM021", "TSM022", "TSM023",
-        "TSM024",
+        "TSM013", "TSM014", "TSM015", "TSM020", "TSM021", "TSM022",
+        "TSM023", "TSM024",
     }
     assert expected <= set(CATALOG)
     for code, rule in CATALOG.items():

@@ -77,3 +77,21 @@ def test_multitenancy_probe_tiny_mode(bench):
         assert e["updated_tenant_matches_oracle"]
     assert d["zero_config_change_recompiles"]
     assert d["all_outputs_match"]
+
+
+def test_tenant_slo_probe_tiny_mode(bench):
+    """Phase T SLO leg in tiny mode: an 8-tenant fleet with one tenant
+    flooding 5x its quota — the flooder's error SLO goes CRIT with a
+    burned budget, the other 7 tenants stay OK, and the /tenants.json
+    view assembles."""
+    d = bench.tenant_slo_probe(
+        tenants=8, records_per_tenant=4, flood_factor=5, batch_size=16
+    )
+    assert d["tenants"] == 8
+    assert d["events_per_s"] > 0
+    # 20 offered, 4 admitted: 16/20 diverted
+    assert d["flooder_error_rate"] == pytest.approx(0.8)
+    assert d["flooder_level"] == "crit"
+    assert d["flooder_budget_burn"] == pytest.approx(1.0)
+    assert d["others_ok"] == 7
+    assert d["tenants_json_scrape_ms"] >= 0

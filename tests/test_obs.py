@@ -259,10 +259,14 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 60
+    assert int(m.group(1)) == 68
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     assert "ok: prometheus carries the fleet gauges" in out
+    # ... including the per-tenant SLO / budget-burn surface
+    assert "ok: health carries the per-tenant SLO rule states" in out
+    assert "ok: breaching tenant burns its error budget" in out
+    assert "ok: tenants render carries the SLO verdicts" in out
     # the pre-flight analysis counter checks are part of the suite
     assert "ok: prometheus carries the per-code analysis findings" in out
 

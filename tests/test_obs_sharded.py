@@ -256,6 +256,73 @@ def test_timeseries_merge_p8_matches_single_chip_oracle():
     assert mh.history.mean(9.0) == pytest.approx(oh.history.mean(9.0))
 
 
+def test_tenant_series_merge_p8_matches_single_chip_oracle():
+    """Per-tenant latency series across 8 shard registries, merged into
+    one coordinator registry: each tenant's merged histogram is lossless
+    (windowed quantiles match an oracle registry that saw every one of
+    that tenant's observations), and tenant SLO rules evaluated over the
+    MERGED series attribute the breach to the noisy tenant only."""
+    clk = [0.0]
+    tenants = ["acme", "globex", "initech"]
+    oracle = _pin(MetricsRegistry(), clk)
+    ohs = {
+        t: oracle.group(job="j", tenant=t).histogram("tenant_e2e_latency_ms")
+        for t in tenants
+    }
+    shards = [_pin(MetricsRegistry(), clk) for _ in range(8)]
+    shs = {
+        (i, t): shards[i].group(job="j", tenant=t).histogram(
+            "tenant_e2e_latency_ms"
+        )
+        for i in range(8)
+        for t in tenants
+    }
+    for tick in range(1, 11):
+        clk[0] = float(tick)
+        for i in range(8):
+            for tenant in tenants:
+                # acme is the noisy tenant: 10x everyone's latency
+                scale = 10.0 if tenant == "acme" else 1.0
+                lat = scale * (i + 1) + tick % 3
+                shs[(i, tenant)].observe(lat)
+                ohs[tenant].observe(lat)
+
+    merged = _pin(MetricsRegistry(), clk)
+    for r in shards:
+        merged.merge(r)
+
+    for tenant in tenants:
+        mh = merged.find(
+            "tenant_e2e_latency_ms", {"job": "j", "tenant": tenant}
+        )
+        oh = ohs[tenant]
+        assert mh.count == oh.count == 80
+        assert mh.sum == pytest.approx(oh.sum)
+        for q in (0.5, 0.9, 0.99):
+            assert mh.percentile(q) == pytest.approx(oh.percentile(q))
+            assert mh.history.quantile(q, 9.0) == pytest.approx(
+                oh.history.quantile(q, 9.0)
+            )
+
+    # per-tenant SLO rules over the merged union: the label filter keeps
+    # each rule on its own tenant's series, so only acme trips
+    from tpustream.obs.slo import TenantSLO, compile_tenant_slo
+
+    engine = HealthEngine([
+        r
+        for t in tenants
+        for r in compile_tenant_slo(
+            t, TenantSLO(p99_ms=20.0, budget_window_s=60.0)
+        )
+    ])
+    state = engine.evaluate(merged.snapshot()["series"], now_s=clk[0])
+    by = {r["rule"]: r for r in state["rules"]}
+    assert by["slo_p99[acme]"]["level"] == "crit"
+    assert by["slo_p99[acme]"]["labels"] == {"tenant": "acme"}
+    assert by["slo_p99[globex]"]["level"] == "ok"
+    assert by["slo_p99[initech]"]["level"] == "ok"
+
+
 def test_sharded_adaptive_controller_output_parity_p8():
     """p=8 with the adaptive controller ticking at flood rate: sink
     output identical to the controller-off run, and the controller left

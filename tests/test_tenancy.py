@@ -192,10 +192,16 @@ def test_hot_add_remove_update_mid_stream_record_exact():
         run_solo(c6.tenant_lines("late", 8), [(0, 88.0)])
     )
     assert recompile_causes(res, "config_change") == []
-    # the per-tenant rule_version gauge got minted on tenant updates
+    # the per-tenant rule_version gauge got minted on tenant updates,
+    # and the REMOVED tenant's series were retired at its removal
+    # boundary — a gone tenant must not linger in scrapes
     series = res.metrics.obs_snapshot()["metrics"]["series"]
     rv = [s for s in series if s["name"] == "tenant_rule_version"]
-    assert {s["labels"].get("tenant") for s in rv} >= {"early", "late"}
+    assert {s["labels"].get("tenant") for s in rv} == {"early"}
+    late = [
+        s for s in series if s["labels"].get("tenant") == "late"
+    ]
+    assert late == []
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +239,119 @@ def test_quota_breach_side_output_does_not_perturb_others():
     assert by[("tenant_records_total", "quiet")] == 12
     assert by[("tenant_quota_exceeded_total", "quiet")] == 0
     assert by[("tenant_count", None)] == 2
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLOs: one noisy neighbor in a 64-tenant fleet
+# ---------------------------------------------------------------------------
+def _noisy_fleet(obs, slo=None):
+    """64 tenants; ``t00`` floods 20x its quota (160 offered, 8
+    admitted). Returns (srv, thresholds, lines)."""
+    thresholds = {f"t{i:02d}": 80.0 + (i % 20) for i in range(64)}
+    srv = make_server(capacity=64, batch_size=64, obs=obs)
+    lines = {}
+    for tenant, thr in thresholds.items():
+        if tenant == "t00":
+            srv.add_tenant(tenant, rules={"threshold": thr},
+                           quota=TenantQuota(max_records=8))
+            lines[tenant] = c6.tenant_lines(tenant, 160)
+        else:
+            srv.add_tenant(tenant, rules={"threshold": thr})
+            lines[tenant] = c6.tenant_lines(tenant, 8)
+        if slo is not None:
+            srv.set_tenant_slo(tenant, slo)
+        srv.ingest(tenant, lines[tenant])
+    return srv, thresholds, lines
+
+
+def test_noisy_neighbor_flooder_crit_others_ok():
+    """The per-tenant SLO acceptance gate (docs/multitenancy.md): in a
+    64-tenant fleet where ONE tenant floods 20x its quota, that
+    tenant's error SLO goes CRIT with a fully burned error budget,
+    every other tenant's rules stay OK on their own independent series,
+    the verdict is scrapeable from ``/tenants.json``, and every
+    tenant's demuxed output is byte-identical to the same fleet with
+    obs off entirely."""
+    import json
+    import urllib.request
+
+    from tpustream.obs import MetricsServer, TenantSLO
+
+    slo = TenantSLO(p99_ms=1e6, max_error_rate=0.01,
+                    budget_window_s=60.0)
+    srv, thresholds, lines = _noisy_fleet(obs=True, slo=slo)
+    res = srv.run("fleet-noisy")
+
+    # the flooder's error rate: 152 of 160 offered records diverted
+    snap = res.metrics.obs_snapshot()
+    err = {
+        s["labels"]["tenant"]: s["value"]
+        for s in snap["metrics"]["series"]
+        if s["name"] == "tenant_error_rate"
+    }
+    assert err["t00"] == pytest.approx(152 / 160)
+    assert all(err[t] == 0.0 for t in thresholds if t != "t00")
+
+    # health verdicts: flooder CRIT, burning budget; >= 60 others OK
+    # (here: all 63)
+    rules = {r["rule"]: r for r in snap["health"]["rules"]}
+    flood = rules["slo_err[t00]"]
+    assert flood["level"] == "crit"
+    assert flood["labels"] == {"tenant": "t00"}
+    assert flood["budget_burn"] == pytest.approx(1.0)
+    ok = [
+        t for t in thresholds if t != "t00"
+        and rules[f"slo_err[{t}]"]["level"] == "ok"
+        and rules[f"slo_p99[{t}]"]["level"] == "ok"
+    ]
+    assert len(ok) == 63
+    # the verdict is a scrapeable series too
+    state = {
+        (s["labels"].get("rule"), s["labels"].get("tenant")): s["value"]
+        for s in snap["metrics"]["series"]
+        if s["name"] == "health_rule_state"
+    }
+    assert state[("slo_err[t00]", "t00")] == 2
+    assert state[("slo_err[t01]", "t01")] == 0
+    # the postmortem names the offending tenant: its health transition
+    # is in the flight ring, filterable by tenant, and nobody else's
+    flight = srv.env.metrics.job_obs.flight
+    t00_events = flight.tenant_events("t00")
+    assert any(
+        e["kind"] == "health_transition"
+        and e["rule"] == "slo_err[t00]" and e["to"] == "crit"
+        for e in t00_events
+    )
+    assert flight.tenant_events("t01") == []
+
+    # /tenants.json over real HTTP carries the same attribution
+    server = MetricsServer(srv.env.metrics.job_obs, port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            server.url + "/tenants.json", timeout=5
+        ).read()
+    finally:
+        server.close()
+    view = json.loads(body.decode("utf-8"))
+    assert view["tenant_count"] == 64
+    flood_view = view["tenants"]["t00"]
+    assert flood_view["quota_exceeded"] == 152
+    assert flood_view["error_rate"] == pytest.approx(152 / 160)
+    assert flood_view["health"]["slo_err[t00]"]["level"] == "crit"
+    ok_view = [
+        t for t, e in view["tenants"].items() if t != "t00"
+        and all(r["level"] == "ok" for r in e["health"].values())
+    ]
+    assert len(ok_view) == 63
+
+    # observing the fleet must not perturb it: byte-identical demux
+    # output (and quota side output) vs the same fleet with obs OFF
+    plain, _, _ = _noisy_fleet(obs=False)
+    plain.run("fleet-noisy-plain")
+    for t in thresholds:
+        assert reprs(srv.output(t)) == reprs(plain.output(t)), t
+    assert srv.quota_output("t00") == plain.quota_output("t00")
+    assert srv.quota_output("t00") == lines["t00"][8:]
 
 
 # ---------------------------------------------------------------------------
@@ -370,6 +489,204 @@ def test_keyed_fleet_namespaces_rolling_state_per_tenant():
             env.from_collection(lines).map(_kv_parse), None
         ).collect()
         env.execute("solo-keyed")
+        return h.items
+
+    assert reprs(srv.output("a")) == reprs(solo(a_lines))
+    assert reprs(srv.output("b")) == reprs(solo(b_lines))
+
+
+# ---------------------------------------------------------------------------
+# fleet op coverage: flat_map / window aggregate / window process
+# ---------------------------------------------------------------------------
+def _expand(line):
+    return line.split("|")
+
+
+def test_fleet_flat_map_solo_parity():
+    """A template that leads with flat_map lowers onto the RAW host
+    stage (the only stage the single-job planner supports it on): the
+    fan-out records stay attributed to their tenant and the demuxed
+    output matches a solo run of the same chain."""
+
+    def tpl(stream, rules):
+        threshold = rules.param("threshold")
+        return stream.flat_map(_expand).filter(
+            lambda value: value.f2 > threshold
+        )
+
+    plan = TenantPlan(
+        parse=c6.parse, build=tpl, rules=c6.make_rules(),
+        tenant_capacity=4,
+    )
+    srv = JobServer(plan, config=StreamConfig(batch_size=4))
+    thresholds = {"ta": 85.0, "tb": 95.0}
+    for tenant, thr in thresholds.items():
+        srv.add_tenant(tenant, rules={"threshold": thr})
+    compound = {
+        t: ["|".join(c6.tenant_lines(t, 8)[i:i + 2]) for i in range(0, 8, 2)]
+        for t in thresholds
+    }
+    for i in range(4):
+        for t in thresholds:
+            srv.ingest(t, [compound[t][i]])
+    srv.run("fleet-flatmap")
+
+    def solo(lines, thr):
+        env = StreamExecutionEnvironment(StreamConfig(batch_size=4))
+        h = (
+            env.from_collection(lines)
+            .flat_map(_expand)
+            .map(c6.parse)
+            .filter(lambda value, _t=thr: value.f2 > _t)
+            .collect()
+        )
+        env.execute("solo-flatmap")
+        return h.items
+
+    for tenant, thr in thresholds.items():
+        assert reprs(srv.output(tenant)) == reprs(
+            solo(compound[tenant], thr)
+        ), tenant
+
+
+def test_fleet_flat_map_after_parsed_op_rejected():
+    """A template flat_map after a parsed-record op is rejected at
+    ADMISSION (TenantPlan.validate_fleet_ops via the JobServer
+    constructor), not three layers deep at run time."""
+    bad = TenantPlan(
+        parse=c6.parse,
+        build=lambda s, r: s.filter(lambda v: v.f2 > 1).flat_map(_expand),
+        rules=c6.make_rules(),
+        tenant_capacity=4,
+    )
+    with pytest.raises(TenantShapeError, match="raw host stage"):
+        JobServer(bad, config=StreamConfig(batch_size=4))
+
+
+class _FleetAvg:
+    """Chapter-2 style Avg whose get_result folds in the tenant's
+    ``threshold`` row: aggregate fns run INSIDE the compiled step, so
+    the RuleParam must gather the firing accumulator's own tenant row
+    (carried as the accumulator's trailing field)."""
+
+    def __init__(self, rules_or_const):
+        self._thr = (
+            rules_or_const.param("threshold")
+            if isinstance(rules_or_const, RuleSet)
+            else rules_or_const
+        )
+
+    def create_accumulator(self):
+        return Tuple2(0, 0.0)
+
+    def add(self, value, acc):
+        return Tuple2(acc.f0 + 1, acc.f1 + value.f1)
+
+    def merge(self, a, b):
+        return Tuple2(a.f0 + b.f0, a.f1 + b.f1)
+
+    def get_result(self, acc):
+        import jax.numpy as jnp
+
+        return jnp.where(acc.f0 == 0, 0.0, acc.f1 / acc.f0) + self._thr
+
+
+def _agg_plan(capacity=4):
+    rules = c6.make_rules()
+    return TenantPlan(
+        parse=_kv_parse,
+        build=lambda s, r: s.key_by(0).count_window(2).aggregate(
+            _FleetAvg(r)
+        ),
+        rules=rules,
+        tenant_capacity=capacity,
+    )
+
+
+def test_fleet_window_aggregate_binds_tenant_rules():
+    """Two tenants share key names and window shapes but carry very
+    different thresholds: each fire's get_result must read ITS tenant's
+    rule row, and the demuxed results must match solo runs with the
+    threshold as a plain constant."""
+    srv = JobServer(_agg_plan(), config=StreamConfig(batch_size=4))
+    srv.add_tenant("a", rules={"threshold": 100.0})
+    srv.add_tenant("b", rules={"threshold": 200.0})
+    a_lines = [f"k{i % 2} {i}" for i in range(8)]
+    b_lines = [f"k{i % 2} {10 * i}" for i in range(8)]
+    for i in range(8):
+        srv.ingest("a", [a_lines[i]])
+        srv.ingest("b", [b_lines[i]])
+    srv.run("fleet-agg")
+
+    def solo(lines, thr):
+        env = StreamExecutionEnvironment(StreamConfig(batch_size=4))
+        h = (
+            env.from_collection(lines)
+            .map(_kv_parse)
+            .key_by(0)
+            .count_window(2)
+            .aggregate(_FleetAvg(thr))
+            .collect()
+        )
+        env.execute("solo-agg")
+        return sorted(float(x) for x in h.items)
+
+    got_a = sorted(float(x) for x in srv.output("a"))
+    got_b = sorted(float(x) for x in srv.output("b"))
+    assert got_a == pytest.approx(solo(a_lines, 100.0))
+    assert got_b == pytest.approx(solo(b_lines, 200.0))
+    # the thresholds actually landed (per-tenant, not global)
+    assert all(100.0 <= x < 200.0 for x in got_a)
+    assert all(x >= 200.0 for x in got_b)
+
+
+def test_fleet_window_process_strips_namespace_and_tenant_field():
+    """The host-evaluated process fn sees the BARE user key (tenant
+    namespace stripped) and elements without the trailing tenant field;
+    its collected output demuxes per tenant, matching a solo run."""
+    from tpustream.tenancy.server import TENANT_SEP
+
+    seen = []
+
+    def fn(key, ctx, elements, out):
+        seen.append((key, list(elements)))
+        total = sum(e.f1 for e in elements)
+        out.collect(Tuple2(key, total))
+
+    plan = TenantPlan(
+        parse=_kv_parse,
+        build=lambda s, r: s.key_by(0).count_window(2).process(fn),
+        rules=_kv_plan().rules,
+        tenant_capacity=4,
+    )
+    srv = JobServer(plan, config=StreamConfig(batch_size=4))
+    srv.add_tenant("a")
+    srv.add_tenant("b")
+    a_lines = [f"k{i % 2} {i}" for i in range(8)]
+    b_lines = [f"k{i % 2} {10 * i}" for i in range(8)]
+    for i in range(8):
+        srv.ingest("a", [a_lines[i]])
+        srv.ingest("b", [b_lines[i]])
+    srv.run("fleet-process")
+
+    assert seen, "process fn never fired"
+    for key, elements in seen:
+        assert TENANT_SEP not in key
+        assert key in ("k0", "k1")
+        for e in elements:
+            assert isinstance(e, Tuple2), repr(e)
+
+    def solo(lines):
+        env = StreamExecutionEnvironment(StreamConfig(batch_size=4))
+        h = (
+            env.from_collection(lines)
+            .map(_kv_parse)
+            .key_by(0)
+            .count_window(2)
+            .process(fn)
+            .collect()
+        )
+        env.execute("solo-process")
         return h.items
 
     assert reprs(srv.output("a")) == reprs(solo(a_lines))

@@ -198,6 +198,15 @@ CATALOG = {
             "restructure the chain per the planner message",
         ),
         Rule(
+            "TSM015", WARN, "health rule references a series no instrument mints",
+            "HealthEngine rules and TenantSLO objectives name their "
+            "series as strings; a typo'd or stale name evaluates "
+            "\"absent\" forever, so the alert can never fire and the "
+            "error budget never burns — silently.",
+            "name a series from the catalog (tpustream/obs/catalog.py, "
+            "docs/observability.md); check for renames after upgrades",
+        ),
+        Rule(
             "TSM020", WARN, "nondeterministic call in a user function",
             "time/random/datetime/uuid calls make replay diverge: a "
             "supervised restart reprocesses records from the last "

@@ -380,13 +380,20 @@ def check_tenant_chain_matches_template(ctx) -> Iterable[Finding]:
     except Exception:
         return
     for chain in ctx.chains:
-        # JobServer.build_job shape: source -> map(parse) -> filter(gate)
-        # -> template ops -> sink; skip anything that isn't that shape
+        # JobServer.build_job shape: source -> [flat_map...] -> map(parse)
+        # -> filter(gate) -> template ops -> sink. Template flat_map
+        # lowers onto the raw stage BEFORE the lazily attached parse, so
+        # any leading flat_map nodes belong to the template signature.
         if len(chain) < 4 or chain[0].op != "source":
             continue
-        if chain[1].op != "map" or chain[2].op != "filter":
+        i = 1
+        while i < len(chain) and chain[i].op == "flat_map":
+            i += 1
+        if i + 2 >= len(chain):
             continue
-        actual = _norm_node_chain(chain[3:])
+        if chain[i].op != "map" or chain[i + 1].op != "filter":
+            continue
+        actual = [("flat_map",)] * (i - 1) + _norm_node_chain(chain[i + 2:])
         if actual != template:
             yield make_finding(
                 "TSM008", chain[3] if len(chain) > 3 else None,
@@ -476,6 +483,42 @@ def check_adaptive_bounds(ctx) -> Iterable[Finding]:
                 "TSM011", None,
                 f"adaptive_bounds[{knob!r}]=({lo}, {hi}) admits no legal "
                 "value (need 1 <= lo <= hi)",
+            )
+
+
+@rule
+def check_health_rule_series_exist(ctx) -> Iterable[Finding]:
+    """TSM015: a HealthEngine rule (ObsConfig.health_rules) or a tenant
+    SLO objective naming a series no instrument mints. The engine
+    evaluates a missing series as "absent" forever, so the alert can
+    never fire — a typo'd name fails silently at the worst time."""
+    from ..obs.catalog import series_is_known
+    from ..obs.health import as_rule
+
+    specs = []
+    for r in getattr(ctx.cfg.obs, "health_rules", ()) or ():
+        try:
+            specs.append(("ObsConfig.health_rules", as_rule(r)))
+        except (TypeError, ValueError):
+            continue
+    server = ctx.tenancy
+    if server is not None:
+        from ..obs.slo import compile_tenant_slo
+
+        for tenant, slo in getattr(server, "_slo", {}).items():
+            try:
+                for r in compile_tenant_slo(tenant, slo):
+                    specs.append((f"TenantSLO({tenant!r})", r))
+            except Exception:
+                continue
+    for origin, r in specs:
+        name = r.series_name
+        if not series_is_known(name):
+            yield make_finding(
+                "TSM015", None,
+                f"{origin} rule {r.name!r} watches series {name!r}, "
+                "which no instrument mints: it evaluates \"absent\" "
+                "forever and the alert can never fire",
             )
 
 

@@ -109,6 +109,20 @@ class RuleParam:
         if leaf is not None:
             return leaf
         desc = self._rules.descriptor(self._name)
+        # host-side per-tenant resolution: a fleet's HOST-evaluated fns
+        # (window process() fires) run under bound_tenant with a plain
+        # int slot but no bound step leaves — resolve that tenant's row.
+        # A traced slot (build-time output inference) falls through to
+        # the scalar host value, as before.
+        tid = getattr(self._rules._tls, "tenant", None)
+        if tid is not None and self._rules.tenant_capacity:
+            try:
+                return jnp.asarray(
+                    self._rules.tenant_value(self._name, int(tid)),
+                    _KIND_DTYPES[desc.kind],
+                )
+            except TypeError:
+                pass
         return jnp.asarray(self._rules.value(self._name), _KIND_DTYPES[desc.kind])
 
     # jnp.asarray / tracer binary ops promote through this, so both

@@ -46,6 +46,13 @@ class ObsConfig:
     # source->here age into an e2e latency histogram. 0 (default) = no
     # stamper installed, SourceBatch.markers stays None, zero cost.
 
+    # -- per-tenant series bounding (docs/multitenancy.md) ------------------
+    tenant_series_topk: int = 64
+    # fleets label latency/SLO series per tenant; only the top-K active
+    # tenants (by admitted records) get their own label value — the rest
+    # fold into one "__other__" bucket so a 10k-tenant fleet cannot
+    # explode the registry. 0 = every active tenant gets a series.
+
     # -- self-monitoring health rules (obs/health.py) -----------------------
     health_rules: tuple = ()
     # AlertRule instances (or their dict form) evaluated over the

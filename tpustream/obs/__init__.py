@@ -93,3 +93,10 @@ from .runtime import (  # noqa: F401
 from .compilation import CompileObs, InstrumentedStep  # noqa: F401
 from .memory import StateMemoryTracker, leaf_nbytes  # noqa: F401
 from .serve import MetricsServer  # noqa: F401
+from .slo import (  # noqa: F401
+    OTHER_TENANT,
+    TenantSLO,
+    compile_tenant_slo,
+    slo_rule_names,
+)
+from .catalog import series_is_known, unknown_series  # noqa: F401

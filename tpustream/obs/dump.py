@@ -8,8 +8,10 @@ Accepts a single-snapshot ``.json`` (from
 is shown unless ``--index`` picks another. ``--prom`` prints the
 embedded Prometheus exposition text verbatim instead of the table view.
 ``--health`` shows the snapshot's embedded health section (rule levels
-and transitions); ``--profile`` shows only the continuous profiler's
-stage-attribution section (binding stage, per-stage shares, occupancy);
+and transitions); ``--tenants`` shows the per-tenant fleet view
+(tenant-labeled series joined with SLO states and budget burn);
+``--profile`` shows only the continuous profiler's stage-attribution
+section (binding stage, per-stage shares, occupancy);
 ``--rules rules.json`` re-evaluates a rule set against the snapshot's
 series offline — postmortem alert-rule replay over any recorded
 snapshot. ``--selftest`` needs no input at all: it pushes a canned
@@ -155,6 +157,66 @@ def render_health(health: dict) -> str:
                 f"{t.get('from', '?')} -> {t.get('to', '?')} "
                 f"({t.get('reason', '')})"
             )
+    return "\n".join(out) + "\n"
+
+
+_LEVELS = {"ok": 0, "warn": 1, "crit": 2}
+
+
+def render_tenants(snap: dict) -> str:
+    """Render the per-tenant fleet view (docs/multitenancy.md): one row
+    per ``tenant`` label value across the snapshot's series, joined with
+    the health section's per-tenant SLO rule states and budget burn."""
+    series = snap.get("metrics", {}).get("series", [])
+    per: dict = {}
+    for s in series:
+        tenant = (s.get("labels") or {}).get("tenant")
+        if tenant is None:
+            continue
+        row = per.setdefault(tenant, {})
+        v = s["value"]
+        if s["type"] == "histogram":
+            if s["name"] == "tenant_e2e_latency_ms":
+                row["e2e_p99_ms"] = v.get("p99")
+        else:
+            row[s["name"]] = v
+    if not per:
+        return "no tenant-labeled series in this snapshot\n"
+    worst: dict = {}
+    burn: dict = {}
+    for r in (snap.get("health") or {}).get("rules", []):
+        tenant = (r.get("labels") or {}).get("tenant")
+        if tenant is None:
+            continue
+        lvl = str(r.get("level", "ok"))
+        if _LEVELS.get(lvl, 0) >= _LEVELS.get(worst.get(tenant, "ok"), 0):
+            worst[tenant] = lvl
+        b = r.get("budget_burn")
+        if b is not None:
+            burn[tenant] = max(float(b), burn.get(tenant, 0.0))
+    out = [f"tenants: {len(per)}"]
+    out.append(
+        f"  {'TENANT':<16} {'RECORDS':>8} {'EMITTED':>8} {'QUOTA':>6} "
+        f"{'DEAD':>5} {'ERR_RATE':>9} {'P99_MS':>9} {'SHARE':>6} "
+        f"{'SLO':<5} BURN"
+    )
+    for tenant in sorted(per):
+        row = per[tenant]
+
+        def _c(name, row=row):
+            v = row.get(name)
+            return "-" if v is None else _fmt_val(v)
+
+        out.append(
+            f"  {tenant:<16} {_c('tenant_records_total'):>8} "
+            f"{_c('tenant_emitted_total'):>8} "
+            f"{_c('tenant_quota_exceeded_total'):>6} "
+            f"{_c('tenant_dead_letter_total'):>5} "
+            f"{_c('tenant_error_rate'):>9} {_c('e2e_p99_ms'):>9} "
+            f"{_c('tenant_step_share'):>6} "
+            f"{worst.get(tenant, '-').upper():<5} "
+            f"{_fmt_val(burn[tenant]) if tenant in burn else '-'}"
+        )
     return "\n".join(out) + "\n"
 
 
@@ -432,6 +494,17 @@ def _selftest() -> int:
     tg.counter("tenant_records_total").set_total(512)
     tg.counter("tenant_quota_exceeded_total").set_total(3)
     tg.gauge("tenant_rule_version").set(4)
+    # per-tenant SLO surface (docs/multitenancy.md "Operating a fleet"):
+    # attributed latency/error series plus a second, healthy tenant so
+    # the --tenants view and the budget burn have a contrast case
+    tg.gauge("tenant_error_rate").set(0.02)
+    th = tg.histogram("tenant_e2e_latency_ms")
+    for v in (5.0, 8.0, 13.0, 55.0):
+        th.observe(v)
+    og = g.group(tenant="globex")
+    og.counter("tenant_records_total").set_total(64)
+    og.gauge("tenant_error_rate").set(0.0)
+    og.histogram("tenant_e2e_latency_ms").observe(4.0)
     # pre-flight analysis series (docs/analysis.md): per-code finding
     # counters the executor mints when the analyzer reports
     g.group(code="TSM009").counter("analysis_findings_total").inc()
@@ -450,8 +523,18 @@ def _selftest() -> int:
         ],
         gauge_group=g,
     )
+    # per-tenant SLOs land in the SAME engine post-construction (the
+    # fleet path): acme breaches both objectives, globex breaches none
+    from .slo import TenantSLO, compile_tenant_slo
+
+    slo = TenantSLO(p99_ms=50.0, max_error_rate=0.01, budget_window_s=60.0)
+    engine.add_rules(compile_tenant_slo("acme", slo))
+    engine.add_rules(compile_tenant_slo("globex", slo))
     snap = job_snapshot(reg, meta={"job": "selftest"})
-    snap["health"] = engine.evaluate(snap["metrics"]["series"], now_s=1.0)
+    # two ticks 30 s apart: the budget burn is the time-weighted breach
+    # fraction of the observed span (acme breached throughout -> 1.0)
+    engine.evaluate(snap["metrics"]["series"], now_s=1.0)
+    snap["health"] = engine.evaluate(snap["metrics"]["series"], now_s=31.0)
     flight = FlightRecorder(capacity=4)
     flight.record("config_resolved", config={"batch_size": 16})
     for i in range(6):
@@ -499,6 +582,14 @@ def _selftest() -> int:
             hz_code = e.code
     finally:
         srv.close()
+
+    _slo_states = {r["rule"]: r["level"] for r in snap["health"]["rules"]}
+    _slo_burns = {
+        r["rule"]: float(r["budget_burn"])
+        for r in snap["health"]["rules"]
+        if r.get("budget_burn") is not None
+    }
+    _tenants_text = render_tenants(snap)
 
     checks = [
         # vs a fresh render, not ``prom``: the health evaluation above
@@ -570,6 +661,27 @@ def _selftest() -> int:
          'tenant_count{job="selftest"} 2' in prom
          and 'tenant_rule_version{job="selftest",tenant="acme"} 4'
          in prom),
+        ("prometheus carries the per-tenant error rate",
+         'tenant_error_rate{job="selftest",tenant="acme"} 0.02' in prom),
+        ("health carries the per-tenant SLO rule states",
+         _slo_states.get("slo_p99[acme]") == "crit"
+         and _slo_states.get("slo_err[acme]") == "crit"),
+        ("healthy tenant's SLO rules stay ok",
+         _slo_states.get("slo_p99[globex]") == "ok"
+         and _slo_states.get("slo_err[globex]") == "ok"),
+        ("breaching tenant burns its error budget",
+         abs(_slo_burns.get("slo_err[acme]", 0.0) - 1.0) < 1e-6),
+        ("healthy tenant keeps its error budget",
+         _slo_burns.get("slo_err[globex]", 1.0) == 0.0),
+        ("per-tenant rule gauges land in the exposition",
+         'health_rule_state{job="selftest",rule="slo_err[acme]",'
+         'tenant="acme"}' in scraped
+         and 'slo_budget_burn{job="selftest",rule="slo_err[acme]",'
+         'tenant="acme"}' in scraped),
+        ("tenants render names both tenants",
+         "acme" in _tenants_text and "globex" in _tenants_text),
+        ("tenants render carries the SLO verdicts",
+         "CRIT" in _tenants_text and "OK" in _tenants_text),
         ("render names the analysis findings counter",
          "analysis_findings_total" in text),
         ("prometheus carries the per-code analysis findings",
@@ -622,6 +734,12 @@ def main(argv=None) -> int:
         "(binding stage, per-stage shares, occupancy)",
     )
     ap.add_argument(
+        "--tenants",
+        action="store_true",
+        help="show only the per-tenant fleet view (tenant-labeled "
+        "series joined with per-tenant SLO states and budget burn)",
+    )
+    ap.add_argument(
         "--rules",
         help="JSON file with a list of alert-rule dicts to (re-)evaluate "
         "against the snapshot's series",
@@ -649,6 +767,11 @@ def main(argv=None) -> int:
         )
     if args.prom:
         sys.stdout.write(snap.get("prometheus", ""))
+    elif args.tenants:
+        out = render_tenants(snap)
+        sys.stdout.write(out)
+        if out.startswith("no tenant-labeled"):
+            return 1
     elif args.profile:
         prof = snap.get("profile")
         if not prof:

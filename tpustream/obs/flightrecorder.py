@@ -73,6 +73,19 @@ class FlightRecorder:
     def events(self) -> list:
         return list(self._ring)
 
+    def tenant_events(self, tenant: str) -> list:
+        """Postmortem triage by tenant: the ring's events that name
+        ``tenant`` — SLO ``health_transition``s carry it via their
+        gauge labels, admission/retirement events (`tenant_obs_retired`,
+        ``tenant_capacity_grown``) directly — so an on-call can ask
+        "what happened to acme" without grepping the whole dump."""
+        return [
+            ev for ev in self._ring
+            if ev.get("tenant") == tenant
+            or (isinstance(ev.get("labels"), dict)
+                and ev["labels"].get("tenant") == tenant)
+        ]
+
     def dump(self, meta: Optional[dict] = None) -> dict:
         """JSON-serializable postmortem bundle."""
         events = self.events()
@@ -116,6 +129,9 @@ class _NullFlightRecorder:
         pass
 
     def events(self) -> list:
+        return []
+
+    def tenant_events(self, tenant: str) -> list:
         return []
 
     def dump(self, meta: Optional[dict] = None) -> dict:

@@ -73,6 +73,16 @@ class AlertRule:
     severity: str = "crit"             # warn | crit
     labels: Tuple[Tuple[str, str], ...] = ()  # label-subset filter
     agg: str = "max"                   # max | min | sum across matches
+    #: extra labels minted onto this rule's ``health_rule_state`` gauge
+    #: (and its transitions) — per-tenant SLO rules carry
+    #: ``{"tenant": "<id>"}`` here so the fleet's rule states are
+    #: addressable as ``health_rule_state{tenant=...}`` series.
+    gauge_labels: Tuple[Tuple[str, str], ...] = ()
+    #: > 0 enables error-budget accounting: the engine tracks the
+    #: time-weighted fraction of the trailing window this rule spent in
+    #: breach and publishes it as an ``slo_budget_burn`` gauge (0.0 =
+    #: full budget left, 1.0 = the whole window breached).
+    budget_window_s: float = 0.0
 
     def __post_init__(self):
         if self.kind not in ("threshold", "rate", "absence"):
@@ -86,6 +96,10 @@ class AlertRule:
         if isinstance(self.labels, dict):
             object.__setattr__(
                 self, "labels", tuple(sorted(self.labels.items()))
+            )
+        if isinstance(self.gauge_labels, dict):
+            object.__setattr__(
+                self, "gauge_labels", tuple(sorted(self.gauge_labels.items()))
             )
 
     @property
@@ -139,28 +153,63 @@ class HealthEngine:
         flight=None,
         max_transitions: int = 256,
     ):
-        self.rules: List[AlertRule] = [as_rule(r) for r in rules]
-        names = [r.name for r in self.rules]
-        if len(set(names)) != len(names):
-            raise ValueError(f"duplicate rule names: {sorted(names)}")
+        self.rules: List[AlertRule] = []
         self.alert_sink = alert_sink
         self.flight = flight
         self.max_transitions = int(max_transitions)
         self.transitions: List[dict] = []
-        self._state = {
-            r.name: {"level": "ok", "breach_since": None, "value": None,
-                     "reason": ""}
-            for r in self.rules
-        }
+        self._state: dict = {}
         # (rule_name, series_key) -> (t_s, value): previous observation
         # for rate / absence rules
         self._prev: dict = {}
         self._gauges = {}
-        if gauge_group is not None:
-            for r in self.rules:
-                self._gauges[r.name] = gauge_group.group(
-                    rule=r.name
-                ).gauge("health_rule_state")
+        self._burn_gauges = {}
+        self._gauge_group = gauge_group
+        self.add_rules(rules)
+
+    def add_rules(self, rules) -> None:
+        """Extend the rule set post-construction — the per-tenant SLO
+        compiler lands its rules here so a fleet can declare SLOs after
+        the engine (and its static config rules) already exist."""
+        fresh = [as_rule(r) for r in rules]
+        names = [r.name for r in self.rules] + [r.name for r in fresh]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names: {sorted(names)}")
+        self.rules.extend(fresh)
+        for r in fresh:
+            self._state[r.name] = {
+                "level": "ok", "breach_since": None, "value": None,
+                "reason": "", "burn": None, "burn_window": [],
+            }
+        if self._gauge_group is not None:
+            for r in fresh:
+                g = self._gauge_group.group(
+                    rule=r.name, **dict(r.gauge_labels)
+                )
+                self._gauges[r.name] = g.gauge("health_rule_state")
+                if r.budget_window_s > 0:
+                    self._burn_gauges[r.name] = g.gauge("slo_budget_burn")
+
+    def remove_rules(self, names) -> None:
+        """Drop rules by name (idempotent) and retire their state
+        gauges from the registry — the counterpart of :meth:`add_rules`
+        for tenant removal, so a removed tenant's
+        ``health_rule_state{tenant=...}`` series stop appearing in
+        snapshots."""
+        doomed = set(names)
+        self.rules = [r for r in self.rules if r.name not in doomed]
+        for n in doomed:
+            self._state.pop(n, None)
+            g = self._gauges.pop(n, None)
+            bg = self._burn_gauges.pop(n, None)
+            reg = getattr(self._gauge_group, "registry", None)
+            if reg is not None:
+                for inst in (g, bg):
+                    if inst is not None:
+                        reg.retire(inst.name, inst.labels)
+        self._prev = {
+            k: v for k, v in self._prev.items() if k[0] not in doomed
+        }
 
     # -- evaluation --------------------------------------------------------
 
@@ -228,11 +277,13 @@ class HealthEngine:
         """Evaluate every rule against ``series`` (a list of
         ``{"name","type","labels","value"}`` dicts) at time ``now_s``
         (seconds, any monotone epoch). Returns :meth:`state`."""
-        for rule in self.rules:
+        for rule in list(self.rules):
             st = self._state[rule.name]
             breach, value, reason = self._observe(rule, series, now_s)
             st["value"] = value
             st["reason"] = reason
+            if rule.budget_window_s > 0:
+                self._account_burn(rule, st, breach, now_s)
             if breach:
                 if st["breach_since"] is None:
                     st["breach_since"] = now_s
@@ -253,6 +304,33 @@ class HealthEngine:
                 g.set(LEVEL_VALUE[st["level"]])
         return self.state(now_s)
 
+    def _account_burn(self, rule, st, breach: bool, now_s: float) -> None:
+        """Error-budget burn: the time-weighted breach fraction over the
+        trailing ``budget_window_s``. Each tick contributes the interval
+        since the previous tick, attributed to that interval's breach
+        state; intervals older than the window roll off. O(ticks in
+        window) per rule per tick."""
+        win = st["burn_window"]
+        win.append((now_s, bool(breach)))
+        lo = now_s - rule.budget_window_s
+        while len(win) > 1 and win[1][0] <= lo:
+            win.pop(0)
+        if len(win) < 2:
+            st["burn"] = 1.0 if breach else 0.0
+        else:
+            breached = total = 0.0
+            for (t0, _), (t1, b1) in zip(win, win[1:]):
+                dt = max(0.0, t1 - max(t0, lo))
+                total += dt
+                if b1:
+                    breached += dt
+            st["burn"] = breached / total if total > 0 else (
+                1.0 if breach else 0.0
+            )
+        bg = self._burn_gauges.get(rule.name)
+        if bg is not None:
+            bg.set(round(st["burn"], 6))
+
     def _transition(self, rule, prev, new, value, reason, now_s):
         report = {
             "rule": rule.name,
@@ -262,6 +340,8 @@ class HealthEngine:
             "value": value,
             "reason": reason,
         }
+        if rule.gauge_labels:
+            report.update(dict(rule.gauge_labels))
         self.transitions.append(report)
         if len(self.transitions) > self.max_transitions:
             del self.transitions[: len(self.transitions)
@@ -289,18 +369,21 @@ class HealthEngine:
         rules = []
         for r in self.rules:
             st = self._state[r.name]
-            rules.append(
-                {
-                    "rule": r.name,
-                    "metric": r.metric,
-                    "kind": r.kind,
-                    "severity": r.severity,
-                    "level": st["level"],
-                    "value": st["value"],
-                    "reason": st["reason"],
-                    "breach_since_s": st["breach_since"],
-                }
-            )
+            entry = {
+                "rule": r.name,
+                "metric": r.metric,
+                "kind": r.kind,
+                "severity": r.severity,
+                "level": st["level"],
+                "value": st["value"],
+                "reason": st["reason"],
+                "breach_since_s": st["breach_since"],
+            }
+            if r.gauge_labels:
+                entry["labels"] = dict(r.gauge_labels)
+            if st.get("burn") is not None:
+                entry["budget_burn"] = round(st["burn"], 6)
+            rules.append(entry)
         out = {
             "level": self.level(),
             "rules": rules,

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from typing import Optional
 
 
 @dataclass
@@ -40,12 +41,21 @@ class LatencyMarker:
     ``(edge, age_ms)`` hops the marker has crossed — cheap (a handful of
     tuples per marker) and it turns any single marker into a readable
     per-stage latency breakdown in tests and flight dumps.
+
+    ``tenant`` attributes the marker to one logical job of a fleet
+    (docs/multitenancy.md): the JobServer's round-robin provider labels
+    each minted marker with an active tenant, and the terminal stage
+    routes its sink-edge age into that tenant's
+    ``tenant_e2e_latency_ms{tenant=...}`` series alongside the fused
+    job-level histogram. ``None`` (single-job runs) keeps the PR 1
+    behaviour exactly.
     """
 
     marker_id: int
     source: str = "source"
     emitted_at_ns: int = 0
     trace: list = field(default_factory=list)
+    tenant: Optional[str] = None
 
     def __post_init__(self):
         if not self.emitted_at_ns:
@@ -72,12 +82,16 @@ class MarkerStamper:
     """
 
     def __init__(self, interval_ms: float, source: str = "source",
-                 counter=None):
+                 counter=None, tenant_provider=None):
         self.interval_s = max(0.0, float(interval_ms)) / 1000.0
         self.source = source
         self._counter = counter      # obs Counter: markers emitted
         self._next_id = 0
         self._last_emit_s = None     # None -> first batch gets a marker
+        # callable() -> Optional[str]: the tenant label for the NEXT
+        # marker (the JobServer installs a round-robin over its active
+        # tenants, bounded to top-K + "__other__"). None = unlabeled.
+        self.tenant_provider = tenant_provider
 
     def poll(self, now_s: float = 0.0):
         """-> LatencyMarker if one is due at ``now_s`` (monotonic
@@ -88,7 +102,13 @@ class MarkerStamper:
             return None
         self._last_emit_s = now_s
         self._next_id += 1
-        m = LatencyMarker(marker_id=self._next_id, source=self.source)
+        tenant = (
+            self.tenant_provider() if self.tenant_provider is not None
+            else None
+        )
+        m = LatencyMarker(
+            marker_id=self._next_id, source=self.source, tenant=tenant
+        )
         if self._counter is not None:
             self._counter.inc()
         return m

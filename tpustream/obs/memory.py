@@ -64,6 +64,12 @@ class StateMemoryTracker:
         self._counts = np.zeros(0, dtype=np.int64)
         self._updates = 0
         self._skew_disabled = False
+        # fleet attribution: the JobServer reads tenant_breakdown() off
+        # this registry at snapshot time (obs/runtime.py JobObs keeps
+        # the list; single-job runs never consult it)
+        trackers = getattr(runner.metrics.job_obs, "state_trackers", None)
+        if trackers is not None:
+            trackers.append(self)
 
         obs.gauge("hbm_state_bytes").set_fn(self.total_bytes)
         shards = runner.program.n_shards
@@ -164,6 +170,45 @@ class StateMemoryTracker:
 
     def cardinality(self) -> Optional[int]:
         return self.occupancy()
+
+    def tenant_breakdown(self) -> dict:
+        """Per-tenant keyed-state attribution from the key namespace:
+        fleet keys are interned as ``"<slot>\\x1f<key>"`` (see
+        docs/multitenancy.md), so counting interned strings by prefix
+        yields each tenant's key cardinality, and the tenant's share of
+        the keyed state components is ``keys/total * keyed_bytes`` (the
+        dense key table allocates uniformly per slot). Returns
+        ``{slot: {"keys": n, "hbm_bytes": b}}``; empty outside a fleet
+        (no separator in any key) or for raw-integer key columns."""
+        t = self._key_table()
+        if t is None or not len(t):
+            return {}
+        sep = "\x1f"
+        per_slot: dict = {}
+        for i in range(len(t)):
+            key = t.lookup(i)
+            if not isinstance(key, str) or sep not in key:
+                continue
+            slot_s = key.split(sep, 1)[0]
+            try:
+                slot = int(slot_s)
+            except ValueError:
+                continue
+            per_slot[slot] = per_slot.get(slot, 0) + 1
+        total = sum(per_slot.values())
+        if not total:
+            return {}
+        comp = self.component_bytes()
+        keyed_bytes = sum(
+            b for c, b in comp.items() if c != "scalars"
+        ) or self.total_bytes()
+        return {
+            slot: {
+                "keys": n,
+                "hbm_bytes": int(round(keyed_bytes * (n / total))),
+            }
+            for slot, n in per_slot.items()
+        }
 
     # -- skew ---------------------------------------------------------------
 

@@ -411,6 +411,25 @@ class MetricsRegistry:
         # a plain iteration over the dict is not
         return [self._by_key[k] for k in sorted(list(self._by_key))]
 
+    def retire(self, name: Optional[str] = None,
+               labels: Optional[Dict[str, str]] = None) -> int:
+        """Drop every series matching ``name`` (None = any name) whose
+        labels are a SUPERSET of ``labels`` — the lifecycle counterpart
+        of idempotent minting. A fleet retires a removed tenant's
+        per-tenant series (``retire(labels={"tenant": "acme"})``) so
+        snapshots and scrapes stop carrying gauges for jobs that no
+        longer exist; re-minting the same (name, labels) later starts a
+        fresh instrument. Returns the number of series dropped."""
+        want = _label_key(labels or {})
+        doomed = [
+            key for key, inst in list(self._by_key.items())
+            if (name is None or key[0] == name)
+            and all(item in key[1] for item in want)
+        ]
+        for key in doomed:
+            del self._by_key[key]
+        return len(doomed)
+
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold another registry's series into this one, loss-free for
         totals: counters sum, gauges take the other's last write (or its

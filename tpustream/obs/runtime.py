@@ -152,6 +152,12 @@ class JobObs:
         self.snapshotter.health_engine = self.health
         # gauge callback errors leave a (once-per-gauge) breadcrumb
         self.registry.flight = self.flight
+        # multi-tenant fleet root (tenancy/server.py attaches itself):
+        # source of the /tenants.json view and the per-tenant SLO rules
+        self.tenancy = None
+        # StateMemoryTracker instances register here (obs/memory.py) so
+        # the fleet can read per-tenant keyed-state breakdowns
+        self.state_trackers: list = []
 
         # live scrape endpoint (obs/serve.py): /metrics + /healthz +
         # /snapshot.json on a daemon thread, ephemeral port when 0
@@ -210,6 +216,44 @@ class JobObs:
 
     def to_prometheus_text(self) -> str:
         return self.registry.to_prometheus_text()
+
+    # -- multi-tenancy ------------------------------------------------------
+
+    def ensure_health(self) -> HealthEngine:
+        """The job's health engine, created on demand: a fleet that
+        declares per-tenant SLOs needs an engine even when the config
+        set no static ``health_rules``."""
+        if self.health is None:
+            self.health = HealthEngine(
+                (),
+                alert_sink=None,
+                gauge_group=self.group,
+                flight=self.flight,
+            )
+            self.snapshotter.health_engine = self.health
+        return self.health
+
+    def attach_tenancy(self, server) -> None:
+        """Install a JobServer as this job's fleet root: its per-tenant
+        refresh runs before every snapshot (so derived series — rates,
+        shares, error fractions — are current at exactly the snapshot
+        cadence), and ``/tenants.json`` serves its fleet view."""
+        self.tenancy = server
+        refresh = getattr(server, "refresh_obs", None)
+        if refresh is not None:
+            self.snapshotter.pre_hooks.append(refresh)
+        # the server registers declared TenantSLOs as health rules and
+        # seeds its per-tenant instruments against THIS obs root
+        hook = getattr(server, "on_obs_attached", None)
+        if hook is not None:
+            hook(self)
+
+    def tenants_snapshot(self) -> Optional[dict]:
+        """Live per-tenant fleet view (the /tenants.json body), or None
+        on single-job runs (the serve layer answers 404)."""
+        if self.tenancy is None:
+            return None
+        return self.tenancy.tenants_snapshot()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -325,11 +369,21 @@ class _NullJobObs:
     health = None
     flight_dump_path = ""
     server = None
+    tenancy = None
 
     __slots__ = ()
 
     def operator(self, name: str):
         return NULL_OPERATOR_OBS
+
+    def ensure_health(self):
+        return None
+
+    def attach_tenancy(self, server) -> None:
+        pass
+
+    def tenants_snapshot(self):
+        return None
 
     def counter(self, name: str):
         return NULL_COUNTER

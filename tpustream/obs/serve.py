@@ -11,6 +11,9 @@
   + the continuous profiler's ``profile`` section)
 * ``GET /profile.json``  — just the profiler's windowed stage
   attribution (binding stage, shares, occupancy), cheap to poll
+* ``GET /tenants.json``  — per-tenant fleet view (admission/emit/error
+  rates, SLO levels, budget burn) when a JobServer is attached; 404 on
+  single-job runs
 
 Everything else is 404; non-GET methods are 405. The server is pure
 stdlib (no deps), started/stopped by ``execute_job`` alongside the
@@ -105,6 +108,17 @@ class MetricsServer:
                 body = json.dumps(
                     self._provider.snapshot(), default=str
                 ).encode("utf-8")
+                return 200, "application/json", body
+            if path == "/tenants.json":
+                tenants = getattr(self._provider, "tenants_snapshot", None)
+                view = tenants() if tenants is not None else None
+                if view is None:
+                    return (
+                        404,
+                        "application/json",
+                        b'{"error": "no tenancy attached (single-job run)"}',
+                    )
+                body = json.dumps(view, default=str).encode("utf-8")
                 return 200, "application/json", body
             if path == "/profile.json":
                 profiler = getattr(self._provider, "profiler", None)

@@ -86,6 +86,13 @@ class Snapshotter:
         # rules tick exactly as often as snapshots (the design point:
         # self-monitoring shares the snapshot cadence, no extra timers)
         self.health_engine = None
+        # callables run at the START of every take(), before the
+        # registry is read: push-style refreshers (the JobServer's
+        # per-tenant admission/emit/share gauges) use this to make
+        # derived series current at exactly the snapshot cadence
+        # without paying on the batch path. Exceptions are swallowed —
+        # a broken refresher must never abort a snapshot.
+        self.pre_hooks: List = []
         self.closed = False
 
     @property
@@ -125,6 +132,11 @@ class Snapshotter:
         meta["at_s"] = round(at_s, 6)
         if skew_ms is not None:
             meta["tick_skew_ms"] = round(skew_ms, 3)
+        for hook in self.pre_hooks:
+            try:
+                hook()
+            except Exception:
+                pass
         # profile BEFORE the registry snapshot: profile() pushes the
         # binding/occupancy/share gauges, and this snapshot's series
         # should match its embedded profile section

@@ -623,6 +623,10 @@ class Runner:
             self.obs.histogram(f"sink{i}_e2e_latency_ms")
             for i in range(len(self.sinks))
         ]
+        # fleet runs: tenant-labeled e2e histograms, minted lazily per
+        # label the round-robin stamper actually emits (bounded upstream
+        # to top-K + "__other__" by the JobServer)
+        self._tenant_e2e: Dict[str, object] = {}
         # flight breadcrumb: one per program compile (no-op when obs off)
         self._flight.record(
             "program_built",
@@ -1432,6 +1436,16 @@ class Runner:
         if self.downstream is not None:
             self._marker_out.extend(markers)
             return
+        for m in markers:
+            if m.tenant is None:
+                continue
+            h = self._tenant_e2e.get(m.tenant)
+            if h is None:
+                h = self.metrics.job_obs.group.group(
+                    tenant=m.tenant
+                ).histogram("tenant_e2e_latency_ms")
+                self._tenant_e2e[m.tenant] = h
+            h.observe(m.age_ms(now_ns))
         for i, h in enumerate(self._sink_e2e):
             for m in markers:
                 h.observe(m.observe(f"sink{i}", now_ns))
@@ -2436,6 +2450,12 @@ def _execute_job(env, sink_nodes) -> JobResult:
         metrics.job_obs = job_obs
         if supervision is not None:
             supervision.seed_metrics(job_obs)
+        # fleet runs (tenancy/server.py): wire the JobServer into the
+        # obs root — per-tenant admission/error/step-share gauges refresh
+        # at each snapshot tick, tenant SLOs land as health rules, and
+        # /tenants.json gets its provider
+        if getattr(env, "_tenancy", None) is not None:
+            job_obs.attach_tenancy(env._tenancy)
         # first flight event: the exact resolved config — every
         # postmortem starts from the knobs the job actually ran with
         job_obs.flight.record(
@@ -2671,14 +2691,30 @@ def _execute_job(env, sink_nodes) -> JobResult:
         job_obs.gauge("rule_version").set(ruleset.version)
         job_obs.counter("rule_updates_total").inc(len(updates))
         if job_obs.enabled and tenant_slots:
+            from ..broadcast.rules import TENANT_ACTIVE_RULE, _to_bool
+
             srv = getattr(env, "_tenancy", None)
+            # a falsy __tenant_active__ update IS tenant removal: those
+            # slots get their per-tenant series retired, not re-minted —
+            # a removed tenant's gauges must not linger in scrapes
+            removed = {
+                u.tenant for u in updates
+                if getattr(u, "tenant", None) is not None
+                and u.name == TENANT_ACTIVE_RULE
+                and not _to_bool(u.value)
+            }
             for slot in tenant_slots:
+                if slot in removed:
+                    continue
                 label = (
                     srv.tenant_label(slot) if srv is not None else str(slot)
                 )
                 job_obs.group.group(tenant=label).gauge(
                     "tenant_rule_version"
                 ).set(ruleset.version)
+            if removed and srv is not None:
+                for slot in sorted(removed):
+                    srv.retire_tenant_obs(slot, job_obs)
         job_obs.flight.record(
             "rule_applied",
             old_version=old_version,
@@ -2838,11 +2874,20 @@ def _execute_job(env, sink_nodes) -> JobResult:
         # source with no per-batch marker work at all.
         from ..obs.latency import MarkerStamper, stamp_markers
 
+        _tenancy = getattr(env, "_tenancy", None)
         source_batches = stamp_markers(
             source_batches,
             MarkerStamper(
                 cfg.obs.latency_marker_interval_ms,
                 counter=job_obs.counter("latency_markers_emitted"),
+                # fleet runs label markers round-robin over the active
+                # tenants (bounded top-K + "__other__"); the terminal
+                # runner lands them in tenant_e2e_latency_ms{tenant=...}
+                tenant_provider=(
+                    _tenancy.marker_tenant_provider()
+                    if _tenancy is not None
+                    else None
+                ),
             ),
         )
     prepared = map(_prepare, source_batches)

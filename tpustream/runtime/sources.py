@@ -49,6 +49,14 @@ class SourceBatch:
     the raw form feeds the native columnar parser without ever
     materializing per-line Python objects, which is what lets the host
     side keep up with the device at millions of events/sec on one core.
+
+    ``markers`` is the obs control lane riding the data path: latency
+    markers (obs/latency.py) attached by the executor's stamper wrap,
+    which cross every pack/dispatch/fetch/emit edge exactly like the
+    batch's records do. Under a multi-tenant fleet each marker carries
+    a tenant label (the JobServer's round-robin provider), so the
+    source batch is also where per-tenant end-to-end latency samples
+    are born (docs/multitenancy.md).
     """
 
     lines: List[str]

@@ -216,6 +216,25 @@ class TenantPlan:
                 "parameters may differ per tenant"
             )
 
+    def validate_fleet_ops(self) -> None:
+        """Reject template shapes the fleet wrapper cannot thread the
+        tenant field through — at ADMISSION time, not three layers deep
+        at run time. Under a JobServer the stream starts raw and
+        ``flat_map`` lowers onto the raw host stage
+        (tenancy/server.py's ``_TenantStream``), so it is only legal
+        before the first parsed-record op."""
+        parsed = False
+        for op in self.signature():
+            if op[0] != "flat_map":
+                parsed = True
+            elif parsed:
+                raise TenantShapeError(
+                    "the template calls flat_map after a parsed-record "
+                    "op; a fleet lowers flat_map onto the raw host "
+                    "stage, so it must precede every other op "
+                    "(docs/multitenancy.md)"
+                )
+
     def inferred_key_field(self) -> Optional[int]:
         """The explicit key_field, or the first positional key_by in
         the template. A computed KeySelector cannot be namespaced

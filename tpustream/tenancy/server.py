@@ -29,7 +29,19 @@ property:
 * **demux** — sink output lands in one collect handle (so checkpoint
   sink-count rollback works unchanged) and splits back per tenant on
   read, with the namespace prefix stripped — a tenant's output is
-  byte-identical to running its job alone.
+  byte-identical to running its job alone;
+* **observability** — the server is the fleet's tenant-attribution
+  root: it labels the round-robin latency markers
+  (:meth:`JobServer.marker_tenant_provider`), refreshes per-tenant
+  admission/emit/error/step-share gauges at every snapshot tick
+  (:meth:`JobServer.refresh_obs`), compiles declared
+  :class:`~tpustream.obs.slo.TenantSLO` objectives into per-tenant
+  health rules, serves the ``/tenants.json`` fleet view, and retires a
+  removed tenant's series at the exact record boundary the removal
+  lands (:meth:`JobServer.retire_tenant_obs`). Label cardinality is
+  bounded by ``ObsConfig.tenant_series_topk``: only the top-K active
+  tenants by admitted records get their own label value; the rest fold
+  into one ``__other__`` bucket.
 """
 
 from __future__ import annotations
@@ -39,6 +51,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 
 from ..api.datastream import DataStream, KeyedStream, WindowedStream
+from ..api.functions import Collector, as_callable
 from ..api.graph import Node
 from ..api.tuples import TupleBase, make_tuple
 from ..broadcast.rules import (
@@ -48,6 +61,12 @@ from ..broadcast.rules import (
     RuleUpdate,
 )
 from ..config import StreamConfig
+from ..obs.slo import (
+    OTHER_TENANT,
+    TenantSLO,
+    compile_tenant_slo,
+    slo_rule_names,
+)
 from .plan import TenantPlan, TenantQuota
 
 #: separates the tenant slot from the payload in tagged source lines and
@@ -116,19 +135,50 @@ def _wrap_reduce(rules: RuleSet, fn):
     return tenant_reduce
 
 
+def _wrap_raw_flat_map(fn):
+    """Lift a user ``flat_map`` (``str -> iterable[str]``) onto the
+    TAGGED raw stream: strip the ``"<slot>\\x1f"`` admission tag, run
+    the user fn on the bare payload line, and re-tag every output line
+    so fan-out records stay attributed to their tenant."""
+    call = as_callable(fn, "flat_map")
+
+    def tenant_flat_map(line: str):
+        slot_s, payload = line.split(TENANT_SEP, 1)
+        prefix = slot_s + TENANT_SEP
+        return [prefix + out for out in call(payload)]
+
+    return tenant_flat_map
+
+
 class _TenantStream:
     """The DataStream the template build fn sees: every user fn is
     wrapped so the trailing tenant field stays invisible and rule
     resolution is per-tenant. Mirrors the DataStream surface the
-    TenantPlan shape probe accepts."""
+    TenantPlan shape probe accepts.
 
-    def __init__(self, stream: DataStream, rules: RuleSet):
+    The underlying stream starts RAW (tagged lines, pre-parse) and is
+    parsed lazily: ``flat_map`` lowers onto the raw host stage — the
+    only stage the single-job planner supports it on
+    (runtime/plan.py) — while the first parsed-record op (map / filter
+    / assign_ts / key_by) triggers ``parse_hook`` to append the shared
+    tagged parse plus the ``__tenant_active__`` liveness gate."""
+
+    def __init__(self, stream: DataStream, rules: RuleSet,
+                 parse_hook=None, parsed: bool = True):
         self._stream = stream
         self._rules = rules
+        self._parse_hook = parse_hook
+        self._parsed = parsed
+
+    def _ensure_parsed(self) -> DataStream:
+        if not self._parsed:
+            self._stream = self._parse_hook(self._stream)
+            self._parsed = True
+        return self._stream
 
     @property
     def node(self) -> Node:
-        return self._stream.node
+        return self._ensure_parsed().node
 
     @property
     def env(self):
@@ -136,24 +186,37 @@ class _TenantStream:
 
     def map(self, fn) -> "_TenantStream":
         return _TenantStream(
-            self._stream.map(_wrap_map(self._rules, fn)), self._rules
+            self._ensure_parsed().map(_wrap_map(self._rules, fn)),
+            self._rules,
         )
 
     def filter(self, fn) -> "_TenantStream":
         return _TenantStream(
-            self._stream.filter(_wrap_filter(self._rules, fn)), self._rules
+            self._ensure_parsed().filter(_wrap_filter(self._rules, fn)),
+            self._rules,
         )
 
-    def flat_map(self, fn):
-        raise NotImplementedError(
-            "flat_map on a tenant fleet stream is not supported yet"
+    def flat_map(self, fn) -> "_TenantStream":
+        if self._parsed:
+            raise NotImplementedError(
+                "flat_map on a tenant fleet stream must come before "
+                "every parsed-record op (map/filter/key_by/assign_ts): "
+                "the fleet lowers it onto the raw host stage, the same "
+                "constraint the single-job planner enforces "
+                "(runtime/plan.py)"
+            )
+        return _TenantStream(
+            self._stream.flat_map(_wrap_raw_flat_map(fn)),
+            self._rules,
+            parse_hook=self._parse_hook,
+            parsed=False,
         )
 
     flatMap = flat_map
 
     def assign_timestamps_and_watermarks(self, assigner) -> "_TenantStream":
         return _TenantStream(
-            self._stream.assign_timestamps_and_watermarks(assigner),
+            self._ensure_parsed().assign_timestamps_and_watermarks(assigner),
             self._rules,
         )
 
@@ -162,7 +225,9 @@ class _TenantStream:
     def key_by(self, key) -> "_TenantKeyedStream":
         # the tenant field is LAST, so positional keys are unchanged;
         # the key column itself is already tenant-namespaced at parse
-        return _TenantKeyedStream(self._stream.key_by(key), self._rules)
+        return _TenantKeyedStream(
+            self._ensure_parsed().key_by(key), self._rules
+        )
 
     keyBy = key_by
 
@@ -217,6 +282,82 @@ class _TenantKeyedStream(_TenantStream):
         return _TenantWindowedStream(self._stream.window(spec), self._rules)
 
 
+class _TenantAggregate:
+    """AggregateFunction proxy for fleets: the accumulator carries the
+    tenant slot as a trailing field (mirroring the record layout), so
+    ``merge``/``get_result`` — which see only accumulators — can still
+    bind the tenant's rule rows. The placeholder slot minted by
+    ``create_accumulator`` is overwritten by the first ``add`` (the
+    window runtime always lifts via ``add(value, create())``, so every
+    live accumulator holds a real slot; padding rows hold garbage the
+    fire mask drops)."""
+
+    def __init__(self, rules: RuleSet, fn):
+        self._rules = rules
+        self._create = as_callable(fn, "create_accumulator")
+        self._add = as_callable(fn, "add")
+        self._merge = as_callable(fn, "merge")
+        self._get_result = as_callable(fn, "get_result")
+
+    def create_accumulator(self):
+        return _pack(_vals(self._create()) + [0])
+
+    def add(self, value, accumulator):
+        vv, va = _vals(value), _vals(accumulator)
+        tid = vv[-1]
+        with self._rules.bound_tenant(tid):
+            out = self._add(_pack(vv[:-1]), _pack(va[:-1]))
+            out_vals = _vals(out)
+        return _pack(out_vals + [tid])
+
+    def merge(self, a, b):
+        va, vb = _vals(a), _vals(b)
+        tid = va[-1]  # same (namespaced) key -> same tenant on both
+        with self._rules.bound_tenant(tid):
+            out = self._merge(_pack(va[:-1]), _pack(vb[:-1]))
+            out_vals = _vals(out)
+        return _pack(out_vals + [tid])
+
+    def get_result(self, accumulator):
+        va = _vals(accumulator)
+        tid = va[-1]
+        with self._rules.bound_tenant(tid):
+            out = self._get_result(_pack(va[:-1]))
+            out_vals = _vals(out)
+        return _pack(out_vals + [tid])
+
+    createAccumulator = create_accumulator
+    getResult = get_result
+
+
+def _wrap_process(rules: RuleSet, fn):
+    """ProcessWindowFunction proxy: recover the tenant slot from the
+    namespaced key (host-evaluated fire, so it is a plain string),
+    strip the namespace prefix and the elements' trailing tenant field,
+    run the user fn under the tenant's rule binding, and re-tag every
+    collected item so demux keeps working."""
+    call = as_callable(fn, "process")
+
+    def tenant_process(key, ctx, elements, out):
+        elements = list(elements)
+        if isinstance(key, str) and TENANT_SEP in key:
+            slot_s, user_key = key.split(TENANT_SEP, 1)
+            tid = int(slot_s)
+        else:
+            # un-namespaced key (explicit key_field=None template):
+            # every element still carries its slot as the last field
+            user_key = key
+            tid = int(_vals(elements[0])[-1]) if elements else 0
+        stripped = [_pack(_vals(e)[:-1]) for e in elements]
+        inner = Collector()
+        with rules.bound_tenant(tid):
+            call(user_key, ctx, stripped, inner)
+        for item in inner.items:
+            out.collect(_pack(_vals(item) + [tid]))
+
+    return tenant_process
+
+
 class _TenantWindowedStream:
     def __init__(self, stream: WindowedStream, rules: RuleSet):
         self._stream = stream
@@ -239,15 +380,16 @@ class _TenantWindowedStream:
             self._stream.reduce(_wrap_reduce(self._rules, fn)), self._rules
         )
 
-    def aggregate(self, fn):
-        raise NotImplementedError(
-            "window aggregate() on a tenant fleet stream is not "
-            "supported yet — express the aggregation as reduce()"
+    def aggregate(self, fn) -> _TenantStream:
+        return _TenantStream(
+            self._stream.aggregate(_TenantAggregate(self._rules, fn)),
+            self._rules,
         )
 
-    def process(self, fn):
-        raise NotImplementedError(
-            "window process() on a tenant fleet stream is not supported yet"
+    def process(self, fn) -> _TenantStream:
+        return _TenantStream(
+            self._stream.process(_wrap_process(self._rules, fn)),
+            self._rules,
         )
 
     def sum(self, pos: int) -> _TenantStream:
@@ -297,6 +439,7 @@ class JobServer:
         self.plan = plan
         self.config = config or StreamConfig()
         plan.rules.enable_tenancy(plan.tenant_capacity)
+        plan.validate_fleet_ops()
         self._key_field = plan.inferred_key_field()
         self._tenants: Dict[str, int] = {}          # tenant id -> slot
         self._active: Dict[str, bool] = {}
@@ -309,6 +452,15 @@ class JobServer:
         self._quota_log: Dict[str, List[str]] = {}
         self._handle = TenantDemuxHandle()
         self.env = None
+        # -- per-tenant observability (docs/multitenancy.md) -----------
+        self._slo: Dict[str, TenantSLO] = {}    # declared objectives
+        self._obs = None                        # JobObs once attached
+        self._rr = -1                           # marker round-robin cursor
+        self._demux_scan = 0                    # _handle.items scan cursor
+        self._dead_scan = 0                     # env.dead_letters cursor
+        self._emitted_by_slot: Dict[int, int] = {}
+        self._dead_by_slot: Dict[int, int] = {}
+        self._prev_admitted: Dict[str, int] = {}  # step-share window base
 
     # -- fleet control (position-addressed: effective at the stream
     # -- position of the call, exactly) ---------------------------------
@@ -318,14 +470,19 @@ class JobServer:
         rules: Optional[Dict[str, Any]] = None,
         quota: Optional[TenantQuota] = None,
         build=None,
+        slo: Optional[TenantSLO] = None,
     ) -> int:
         """Admit a tenant at the current stream position: verify its job
         shape (when it submits one), assign a slot, and schedule its
-        activation + initial rule rows. Returns the slot."""
+        activation + initial rule rows. An optional :class:`TenantSLO`
+        declares the tenant's latency/error objectives (see
+        :meth:`set_tenant_slo`). Returns the slot."""
         if tenant in self._tenants:
             raise ValueError(f"tenant {tenant!r} already admitted")
         if build is not None:
             self.plan.verify(build)
+        if slo is not None:
+            self._slo[tenant] = slo
         slot = len(self._tenants)
         pos = len(self._lines)
         self._tenants[tenant] = slot
@@ -446,13 +603,27 @@ class JobServer:
         rules = self.plan.rules
         env._tenancy = self
         env.add_source(ReplaySource(list(self._updates))).broadcast(rules)
+
+        def _attach_parse(raw: DataStream) -> DataStream:
+            # the shared tagged parse, then the liveness gate: resolves
+            # per record to the tenant's __tenant_active__ row; removed
+            # tenants' rows drop here
+            parsed = raw.map(self._parse_tagged)
+            active = rules.param(TENANT_ACTIVE_RULE)
+            return parsed.filter(
+                _wrap_filter(
+                    rules, lambda _rec: jnp.asarray(active, jnp.bool_)
+                )
+            )
+
+        # the stream starts RAW so template flat_map lowers onto the
+        # host stage; the first parsed-record op attaches the parse
         stream = _TenantStream(
-            env.from_collection(self._lines).map(self._parse_tagged), rules
+            env.from_collection(self._lines),
+            rules,
+            parse_hook=_attach_parse,
+            parsed=False,
         )
-        # the liveness gate: resolves per record to the tenant's
-        # __tenant_active__ row; removed tenants' rows drop here
-        active = rules.param(TENANT_ACTIVE_RULE)
-        stream = stream.filter(lambda _rec: jnp.asarray(active, jnp.bool_))
         out = self.plan.build(stream, rules)
         node = Node("sink_collect", out.node, {"handle": self._handle})
         env._register_sink(node)
@@ -471,8 +642,10 @@ class JobServer:
         return result
 
     def _mint_obs(self, job_name: str) -> None:
-        """Per-tenant-labeled series (docs/observability.md): fleet size
-        plus per-tenant admission/quota counters."""
+        """Post-run per-tenant series (docs/observability.md): fleet
+        size plus per-tenant admission/quota counters for every ACTIVE
+        tenant — removed tenants' series were retired at their removal
+        boundary and must not resurrect here."""
         metrics = getattr(self.env, "metrics", None)
         registry = getattr(metrics, "registry", None)
         if registry is None:
@@ -482,13 +655,262 @@ class JobServer:
             sum(1 for t in self._tenants if self._active[t])
         )
         for tenant in self._tenants:
-            tg = g.group(tenant=tenant)
+            if not self._active[tenant]:
+                continue
+            tg = g.group(tenant=self._obs_label(tenant))
             tg.counter("tenant_records_total").set_total(
                 self._admitted[tenant]
             )
             tg.counter("tenant_quota_exceeded_total").set_total(
                 self._rejected[tenant]
             )
+
+    # -- per-tenant SLO observability (docs/multitenancy.md) -------------
+    def set_tenant_slo(self, tenant: str, slo: Optional[TenantSLO]) -> None:
+        """Declare (or clear, with None) one tenant's SLO. Compiled into
+        per-tenant health rules when the fleet's obs root attaches — or
+        immediately, when it already has."""
+        self._slot(tenant)
+        if slo is None:
+            self._slo.pop(tenant, None)
+        else:
+            self._slo[tenant] = slo
+        obs = self._obs
+        if obs is None or not getattr(obs, "enabled", False):
+            return
+        engine = obs.ensure_health()
+        engine.remove_rules(slo_rule_names(tenant))
+        if slo is not None:
+            engine.add_rules(compile_tenant_slo(tenant, slo))
+
+    setTenantSLO = set_tenant_slo
+
+    def on_obs_attached(self, job_obs) -> None:
+        """JobObs.attach_tenancy calls back here once per attempt: keep
+        the obs root, reset the incremental demux cursors (a supervised
+        restart replays the handle from its rollback point), and compile
+        every declared SLO into the engine."""
+        self._obs = job_obs
+        self._demux_scan = 0
+        self._dead_scan = 0
+        self._emitted_by_slot = {}
+        self._dead_by_slot = {}
+        self._prev_admitted = {}
+        if not getattr(job_obs, "enabled", False):
+            return
+        slos = {t: s for t, s in self._slo.items() if self._active.get(t)}
+        if slos:
+            engine = job_obs.ensure_health()
+            for tenant, slo in slos.items():
+                engine.remove_rules(slo_rule_names(tenant))
+                engine.add_rules(compile_tenant_slo(tenant, slo))
+
+    def _bounded_labels(self) -> List[str]:
+        """Active tenants that get their OWN series label value, plus
+        ``__other__`` when the fleet overflows
+        ``ObsConfig.tenant_series_topk`` (0 = unbounded). Ranking is by
+        admitted records (the attribution that matters for a noisy
+        fleet), tenant id as the tiebreak."""
+        active = [t for t in self._tenants if self._active[t]]
+        k = int(getattr(self.config.obs, "tenant_series_topk", 0) or 0)
+        if k <= 0 or len(active) <= k:
+            return active
+        ranked = sorted(active, key=lambda t: (-self._admitted[t], t))
+        return ranked[:k] + [OTHER_TENANT]
+
+    def _obs_label(self, tenant: str) -> str:
+        labels = self._bounded_labels()
+        return tenant if tenant in labels else OTHER_TENANT
+
+    def marker_tenant_provider(self):
+        """Round-robin tenant labeler for the source MarkerStamper: each
+        minted latency marker is attributed to the next bounded label,
+        so every active tenant's ``tenant_e2e_latency_ms`` series keeps
+        filling at 1/N of the marker rate."""
+
+        def next_tenant() -> Optional[str]:
+            labels = self._bounded_labels()
+            if not labels:
+                return None
+            self._rr = (self._rr + 1) % len(labels)
+            return labels[self._rr]
+
+        return next_tenant
+
+    def refresh_obs(self) -> None:
+        """Snapshot pre-hook (obs/snapshot.py): refresh every derived
+        per-tenant series so each snapshot/scrape sees current values.
+        Incremental — the demux handle and dead-letter list are scanned
+        from the previous cursor, never from zero."""
+        obs = self._obs
+        if obs is None or not getattr(obs, "enabled", False):
+            return
+        g = obs.group
+        active = [t for t in self._tenants if self._active[t]]
+        g.gauge("tenant_count").set(len(active))
+        # emitted records, attributed by the trailing slot field
+        items = self._handle.items
+        for item in items[self._demux_scan:]:
+            try:
+                slot = int(_vals(item)[-1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            self._emitted_by_slot[slot] = (
+                self._emitted_by_slot.get(slot, 0) + 1
+            )
+        self._demux_scan = len(items)
+        # dead letters carry the admission tag prefix on the raw line
+        dead = getattr(self.env, "dead_letters", None) or []
+        for entry in dead[self._dead_scan:]:
+            line = entry[0] if isinstance(entry, tuple) else str(entry)
+            if TENANT_SEP not in line:
+                continue
+            try:
+                slot = int(line.split(TENANT_SEP, 1)[0])
+            except ValueError:
+                continue
+            self._dead_by_slot[slot] = self._dead_by_slot.get(slot, 0) + 1
+        self._dead_scan = len(dead)
+        # keyed-state attribution from every runner's key namespace
+        state: Dict[int, Dict[str, int]] = {}
+        for tracker in getattr(obs, "state_trackers", ()):
+            for slot, entry in tracker.tenant_breakdown().items():
+                agg = state.setdefault(slot, {"keys": 0, "hbm_bytes": 0})
+                agg["keys"] += entry["keys"]
+                agg["hbm_bytes"] += entry["hbm_bytes"]
+        # fold per-tenant numbers into the bounded label buckets
+        totals: Dict[str, Dict[str, float]] = {}
+        window_total = 0
+        for tenant in active:
+            slot = self._tenants[tenant]
+            label = self._obs_label(tenant)
+            agg = totals.setdefault(
+                label,
+                {
+                    "admitted": 0, "rejected": 0, "emitted": 0,
+                    "dead": 0, "keys": 0, "hbm": 0, "delta": 0,
+                },
+            )
+            agg["admitted"] += self._admitted[tenant]
+            agg["rejected"] += self._rejected[tenant]
+            agg["emitted"] += self._emitted_by_slot.get(slot, 0)
+            agg["dead"] += self._dead_by_slot.get(slot, 0)
+            st = state.get(slot)
+            if st is not None:
+                agg["keys"] += st["keys"]
+                agg["hbm"] += st["hbm_bytes"]
+            delta = self._admitted[tenant] - self._prev_admitted.get(
+                tenant, 0
+            )
+            agg["delta"] += delta
+            window_total += delta
+        for label, agg in totals.items():
+            tg = g.group(tenant=label)
+            tg.counter("tenant_records_total").set_total(agg["admitted"])
+            tg.counter("tenant_quota_exceeded_total").set_total(
+                agg["rejected"]
+            )
+            tg.counter("tenant_emitted_total").set_total(agg["emitted"])
+            tg.counter("tenant_dead_letter_total").set_total(agg["dead"])
+            offered = agg["admitted"] + agg["rejected"]
+            tg.gauge("tenant_error_rate").set(
+                (agg["rejected"] + agg["dead"]) / offered if offered else 0.0
+            )
+            tg.gauge("tenant_step_share").set(
+                agg["delta"] / window_total if window_total else 0.0
+            )
+            tg.gauge("tenant_state_keys").set(agg["keys"])
+            tg.gauge("tenant_hbm_state_bytes").set(agg["hbm"])
+        self._prev_admitted = {t: self._admitted[t] for t in active}
+
+    def retire_tenant_obs(self, slot: int, job_obs) -> None:
+        """A tenant's removal landed at its record boundary: drop every
+        series labeled with the tenant and its compiled SLO rules, so
+        scrapes stop carrying gauges for a job that no longer exists
+        (the fix for lingering ``tenant_rule_version`` gauges)."""
+        label = self.tenant_label(slot)
+        registry = getattr(job_obs, "registry", None)
+        n = (
+            registry.retire(labels={"tenant": label})
+            if registry is not None
+            else 0
+        )
+        health = getattr(job_obs, "health", None)
+        if health is not None:
+            health.remove_rules(slo_rule_names(label))
+        job_obs.flight.record(
+            "tenant_obs_retired", tenant=label, slot=slot, series=n
+        )
+
+    def tenants_snapshot(self) -> dict:
+        """The ``/tenants.json`` body: one entry per tenant (active and
+        removed) with admission/emit/error attribution, the declared
+        SLO, its compiled rules' live health levels, and budget burn."""
+        self.refresh_obs()
+        obs = self._obs
+        health_rules: Dict[str, dict] = {}
+        if obs is not None and getattr(obs, "health", None) is not None:
+            health_rules = {
+                r["rule"]: r for r in obs.health.state().get("rules", [])
+            }
+        p99 = {}
+        if obs is not None and getattr(obs, "enabled", False):
+            registry = obs.registry
+            base = dict(obs.group.labels)
+            for label in self._bounded_labels():
+                hist = registry.find(
+                    "tenant_e2e_latency_ms", {**base, "tenant": label}
+                )
+                if hist is not None:
+                    p99[label] = round(hist.percentile(99), 3)
+        tenants = {}
+        for tenant, slot in self._tenants.items():
+            offered = self._admitted[tenant] + self._rejected[tenant]
+            dead = self._dead_by_slot.get(slot, 0)
+            entry = {
+                "slot": slot,
+                "active": self._active[tenant],
+                "admitted": self._admitted[tenant],
+                "quota_exceeded": self._rejected[tenant],
+                "emitted": self._emitted_by_slot.get(slot, 0),
+                "dead_letters": dead,
+                "error_rate": (
+                    (self._rejected[tenant] + dead) / offered
+                    if offered else 0.0
+                ),
+                "label": self._obs_label(tenant),
+            }
+            if tenant in p99:
+                entry["e2e_p99_ms"] = p99[tenant]
+            slo = self._slo.get(tenant)
+            if slo is not None:
+                entry["slo"] = {
+                    "p99_ms": slo.p99_ms,
+                    "max_error_rate": slo.max_error_rate,
+                    "budget_window_s": slo.budget_window_s,
+                }
+                rules = {}
+                for name in slo_rule_names(tenant):
+                    st = health_rules.get(name)
+                    if st is not None:
+                        rules[name] = {
+                            "level": st.get("level"),
+                            "budget_burn": st.get("budget_burn"),
+                        }
+                if rules:
+                    entry["health"] = rules
+            tenants[tenant] = entry
+        return {
+            "tenant_count": sum(
+                1 for t in self._tenants if self._active[t]
+            ),
+            "series_topk": int(
+                getattr(self.config.obs, "tenant_series_topk", 0) or 0
+            ),
+            "tenants": tenants,
+        }
+
+    tenantsSnapshot = tenants_snapshot
 
     # -- output demux ----------------------------------------------------
     def _strip(self, vals: List[Any], slot: int) -> List[Any]:
