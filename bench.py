@@ -735,6 +735,102 @@ def host_chain_rate():
     return rate, n_lines
 
 
+def ingest_lane_sweep(lane_counts=(1, 2, 4), nbuf=30, warm=5,
+                      bl=1 << 16, nkey=1 << 20):
+    """Phase I2: sharded host ingestion (runtime/ingest.py). The same
+    raw-bytes -> parse+intern -> Batch chain as phase I, but driven
+    through the IngestPlane (StreamConfig.ingest_lanes) at each lane
+    count. A sha256 over every merged column and the ts vector proves
+    the merge contract: each lane count must reproduce the lanes=1
+    stream byte-for-byte, so any speedup is free of semantic drift."""
+    import hashlib
+
+    from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+    from tpustream.config import StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.runtime.executor import HostStage
+    from tpustream.runtime.ingest import build_ingest_plane
+    from tpustream.runtime.metrics import Metrics, Stopwatch
+    from tpustream.runtime.plan import build_plan_chain
+
+    tpl, tcols = _render_flagship_lines(bl, nkey)
+    sweep = {
+        "lines_per_run": nbuf * bl,
+        "timed_lines": (nbuf - warm) * bl,
+        "results": [],
+    }
+    base_digest = None
+    for lanes in lane_counts:
+        cfg = StreamConfig(
+            batch_size=bl, key_capacity=nkey, alert_capacity=1 << 16,
+            ingest_lanes=lanes,
+        )
+        env = StreamExecutionEnvironment(cfg)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        build(
+            env, env.add_source(None), size=Time.seconds(5),
+            slide=Time.seconds(1),
+        ).add_sink(lambda r: None)
+        plan = build_plan_chain(env, env._sinks)[0]
+        host = HostStage(plan, cfg)
+
+        def prepare(sb):
+            # mirrors the executor's _prepare: final/empty frames are
+            # host-routed by the plane and must pass through unparsed
+            with Stopwatch() as hw:
+                if sb.final or sb.n_records == 0:
+                    return sb, None, None, hw
+                batch, wm = host.process_raw(sb.raw, sb.n_raw, sb.proc_ts)
+                assert batch is not None, "native raw lane unavailable"
+                return sb, batch, wm, hw
+
+        src = _GenBytesSource(tpl, tcols, nbuf, warm, bl, 1_566_957_600_000)
+        plane = None
+        if lanes > 1:
+            plane = build_ingest_plane(
+                host, cfg.resolve()[0], plan, Metrics().job_obs,
+                single_process=True,
+            )
+            assert plane is not None, "ingest plane refused to build"
+            frames = plane.frames(src.batches(bl, 0.0), prepare)
+        else:
+            frames = map(prepare, src.batches(bl, 0.0))
+        h = hashlib.sha256()
+        n_lines = 0
+        try:
+            for _sb, batch, _wm, _hw in frames:
+                if batch is None:
+                    continue
+                for col in batch.columns:
+                    h.update(np.ascontiguousarray(col.data).tobytes())
+                h.update(np.ascontiguousarray(batch.ts).tobytes())
+                n_lines += batch.n
+        finally:
+            if plane is not None:
+                plane.close()
+        digest = h.hexdigest()
+        if base_digest is None:
+            base_digest = digest
+        rate = src.steady_rate()
+        sweep["results"].append(
+            {
+                "lanes": lanes,
+                "lines_per_s": round(rate),
+                "sha256": digest,
+                "byte_identical_to_1_lane": digest == base_digest,
+                "n_lines": n_lines,
+            }
+        )
+        log(
+            f"  ingest lanes={lanes}: {rate/1e6:.2f}M lines/s, "
+            f"digest {'==' if digest == base_digest else '!='} 1-lane"
+        )
+        assert digest == base_digest, (
+            f"lane merge broke byte parity at lanes={lanes}"
+        )
+    return sweep
+
+
 def device_ch3_tumbling(stream_hash):
     """Config 3 device pipeline: processing-time 1-min tumbling sum
     (chapter3 BandwidthMonitor) driven by an on-device generator with
@@ -1891,6 +1987,22 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase I skipped: {e}")
 
+    # ---- Phase I2: sharded ingestion lane sweep (docs/performance.md) ---
+    lane_sweep = None
+    try:
+        log("phase I2: sharded ingestion (IngestPlane), lane sweep:")
+        lane_sweep = ingest_lane_sweep()
+        peak = max(lane_sweep["results"], key=lambda r: r["lines_per_s"])
+        base = lane_sweep["results"][0]
+        log(
+            f"phase I2: best {peak['lanes']} lane(s) at "
+            f"{peak['lines_per_s']/1e6:.2f}M lines/s "
+            f"({peak['lines_per_s']/max(base['lines_per_s'],1):.2f}x over "
+            f"1 lane), all lane counts byte-identical"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase I2 skipped: {e}")
+
     # ---- Phase H: measured H2D bandwidth (environment context) ----------
     h2d_mb_s = None
     try:
@@ -2225,6 +2337,10 @@ def main():
                     "h2d_bandwidth_mb_per_s": round(h2d_mb_s or 0),
                     "native_parse_lines_per_s": round(parse_rate or 0),
                     "host_chain_lines_per_s": round(chain_rate or 0),
+                    # phase I2: the host chain through the IngestPlane
+                    # per lane count, with the byte-parity digests
+                    # (docs/performance.md "Sharded ingestion")
+                    "ingest_lane_sweep": lane_sweep,
                     # stage-attributed full-path account (phase J):
                     # measured per-batch stage costs, the day's wire
                     # ceiling, and the flood rate as a fraction of it

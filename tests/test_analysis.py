@@ -362,6 +362,75 @@ def test_tsm015_tenant_slo_series_are_cataloged():
     assert "TSM015" not in codes(env.analyze())
 
 
+def test_tsm016_lanes_over_nonsplittable_source():
+    from tpustream.runtime.sources import SocketTextSource
+
+    env = make_env(ingest_lanes=2)
+    (
+        env.add_source(SocketTextSource("localhost", 9999))
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    f = next(f for f in env.analyze() if f.code == "TSM016")
+    assert f.severity == ERROR
+    assert "not line-splittable" in f.message
+
+
+def test_tsm016_lanes_exceeding_host_cores():
+    import os
+
+    lanes = (os.cpu_count() or 1) + 2
+    env = good_job(make_env(ingest_lanes=lanes))
+    f = next(f for f in env.analyze() if f.code == "TSM016")
+    assert f.severity == WARN
+    assert "core" in f.message
+
+
+def test_tsm016_lanes_under_multihost(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    env = good_job(make_env(ingest_lanes=2))
+    f = next(
+        f for f in env.analyze()
+        if f.code == "TSM016" and "multi-host" in f.message
+    )
+    assert f.severity == INFO
+
+
+def test_tsm016_clean_configurations():
+    from tpustream.runtime.sources import SocketTextSource
+
+    # lanes=1: the rule never looks at the source
+    env = make_env()
+    (
+        env.add_source(SocketTextSource("localhost", 9999))
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    assert "TSM016" not in codes(env.analyze())
+    # raw-mode socket IS splittable: no ERROR (a core-count WARN may
+    # still fire on small hosts)
+    env = make_env(ingest_lanes=2)
+    (
+        env.add_source(SocketTextSource("localhost", 9999, raw=True))
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    assert ERROR not in [
+        f.severity for f in env.analyze() if f.code == "TSM016"
+    ]
+
+
 def test_findings_sorted_errors_first():
     # one ERROR (TSM013) + one INFO (TSM010) in a single graph
     env = make_env(async_depth=2)
@@ -564,8 +633,8 @@ def test_catalog_is_stable():
     expected = {
         "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
         "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
-        "TSM013", "TSM014", "TSM015", "TSM020", "TSM021", "TSM022",
-        "TSM023", "TSM024",
+        "TSM013", "TSM014", "TSM015", "TSM016", "TSM020", "TSM021",
+        "TSM022", "TSM023", "TSM024",
     }
     assert expected <= set(CATALOG)
     for code, rule in CATALOG.items():

@@ -58,6 +58,23 @@ def test_decompose_controller_pass_tiny_mode(bench):
     assert c["output_sha"] == c["baseline_sha"]
 
 
+def test_ingest_lane_sweep_tiny_mode(bench):
+    """Phase I2 in tiny mode: the lane sweep runs end to end, every
+    lane count reports a positive rate over the full line budget, and
+    the merged column digests are byte-identical to the 1-lane run —
+    the whole point of the sharded-ingestion contract."""
+    d = bench.ingest_lane_sweep(
+        lane_counts=(1, 2), nbuf=4, warm=1, bl=1024, nkey=1 << 12
+    )
+    assert [e["lanes"] for e in d["results"]] == [1, 2]
+    base = d["results"][0]["sha256"]
+    for e in d["results"]:
+        assert e["lines_per_s"] > 0
+        assert e["n_lines"] == d["lines_per_run"]
+        assert e["sha256"] == base
+        assert e["byte_identical_to_1_lane"]
+
+
 def test_measure_h2d_reports_positive_bandwidth(bench):
     mb_s = bench.measure_h2d()
     assert mb_s > 0

@@ -1,0 +1,90 @@
+"""Native-parser build failure path (tpustream/native): when neither the
+Makefile nor the portable g++ line produces a loadable _fastparse.so,
+the job must keep running on the numpy/python parse path, build_error()
+must say why, and an obs-enabled run must leave the
+``native_parse_unavailable`` flight breadcrumb that explains the
+throughput cliff in a postmortem."""
+
+import subprocess
+
+import pytest
+
+from tpustream import StreamExecutionEnvironment, native
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.runtime.sources import ReplaySource
+
+LINES = [
+    "1563452056 10.8.22.1 cpu0 80.5",
+    "1563452050 10.8.22.1 cpu0 78.4",
+    "1563452056 10.8.22.2 cpu1 40.0",
+    "1563452060 10.8.22.1 cpu0 99.9",
+]
+
+
+@pytest.fixture
+def broken_native(monkeypatch, tmp_path):
+    """Force the next _load() through a failing build: no cached lib, a
+    missing .so path, and a compiler that always errors. monkeypatch
+    restores the real module state afterwards."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_build_error", None)
+    monkeypatch.setattr(native, "_SO", str(tmp_path / "_fastparse.so"))
+
+    def fail(cmd, **kw):
+        raise subprocess.CalledProcessError(
+            1, cmd, stderr=b"fatal error: no such toolchain"
+        )
+
+    monkeypatch.setattr(native.subprocess, "run", fail)
+    return native
+
+
+def test_build_failure_surfaces_error_and_stays_unavailable(broken_native):
+    assert not broken_native.available()
+    err = broken_native.build_error()
+    assert err is not None
+    # both attempts are named with their compiler tails
+    assert "make" in err and "g++" in err and "no such toolchain" in err
+    # the failure is cached — no rebuild storm on every parse call
+    assert not broken_native.available()
+
+
+def test_numpy_fallback_parses_and_leaves_flight_breadcrumb(broken_native):
+    from tpustream.jobs.chapter2_max import build
+
+    env = StreamExecutionEnvironment(
+        StreamConfig(batch_size=2, obs=ObsConfig(enabled=True))
+    )
+    handle = build(env, env.add_source(ReplaySource(LINES))).collect()
+    env.execute("native-fallback-test")
+    # numpy path produced real output
+    assert len(handle.items) == len(LINES)
+    events = env.metrics.job_obs.flight.events()
+    crumbs = [e for e in events if e["kind"] == "native_parse_unavailable"]
+    assert len(crumbs) == 1, [e["kind"] for e in events]
+    assert "no such toolchain" in crumbs[0]["error"]
+
+
+def test_dlopen_failure_triggers_one_rebuild(monkeypatch, tmp_path):
+    """A checked-in .so from another toolchain dlopen-fails even though
+    it is newer than the source: _load() must rebuild once against this
+    toolchain instead of silently dropping to numpy."""
+    so = tmp_path / "_fastparse.so"
+    so.write_bytes(b"\x7fELF not really a library")
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_build_error", None)
+    monkeypatch.setattr(native, "_SO", str(so))
+    calls = []
+
+    def fake_build():
+        calls.append(1)
+        native._build_error = "rebuild failed too"
+        return False
+
+    monkeypatch.setattr(native, "_build", fake_build)
+    assert not native.available()
+    assert len(calls) == 1, "dlopen failure must attempt exactly one rebuild"
+    err = native.build_error()
+    assert "dlopen" in err and "rebuild failed too" in err

@@ -259,9 +259,11 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 68
+    assert int(m.group(1)) == 71
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
+    # ... and the sharded-ingestion lane series
+    assert "ok: prometheus carries the per-lane ingest counters" in out
     assert "ok: prometheus carries the fleet gauges" in out
     # ... including the per-tenant SLO / budget-burn surface
     assert "ok: health carries the per-tenant SLO rule states" in out

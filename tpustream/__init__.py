@@ -21,41 +21,97 @@ reference's Java ``double`` golden outputs bit-for-bit (e.g.
 ``86.26666666666667`` in chapter2/README.md:162).
 """
 
-import jax as _jax
+import os as _os
 
-# Java doubles / epoch-millisecond int64 timestamps need x64. TPU benchmark
-# configs opt back into f32/i32 accumulators via StreamConfig.
-_jax.config.update("jax_enable_x64", True)
+if _os.environ.get("TPUSTREAM_LANE_WORKER") == "1":
+    # Ingest-lane worker process (runtime/ingest.py spawns with this set):
+    # the worker only runs the columnar parse plane
+    # (hostparse + records + native), so the package skips jax and the
+    # full API surface — worker start-up is a numpy import, not a jax
+    # one. Everything a worker unpickles (PExpr plans, StringTables)
+    # lives in modules importable under this gate.
+    #
+    # Escape hatch: under the "spawn" start method the child re-executes
+    # the user's __main__, whose top-level ``from tpustream import ...``
+    # must still resolve — resolve the public names lazily (normal
+    # submodule imports, so class identities stay canonical) so the gate
+    # never breaks a user script, it only defers the jax cost.
+    _LAZY_API = {
+        "Tuple2": "api.tuples", "Tuple3": "api.tuples",
+        "Tuple4": "api.tuples",
+        "Time": "api.timeapi", "TimeCharacteristic": "api.timeapi",
+        "StreamExecutionEnvironment": "api.environment",
+        "AssignerWithPeriodicWatermarks": "api.watermarks",
+        "BoundedOutOfOrdernessTimestampExtractor": "api.watermarks",
+        "Watermark": "api.watermarks",
+        "AggregateFunction": "api.functions",
+        "FilterFunction": "api.functions",
+        "KeySelector": "api.functions", "MapFunction": "api.functions",
+        "ProcessWindowFunction": "api.functions",
+        "ReduceFunction": "api.functions",
+        "OutputTag": "api.output",
+        "Finding": "analysis", "PlanAnalysisError": "analysis",
+        "BroadcastStream": "broadcast", "RuleDescriptor": "broadcast",
+        "RuleParam": "broadcast", "RuleSet": "broadcast",
+        "RuleUpdate": "broadcast",
+        "CEP": "cep", "Pattern": "cep",
+        "PatternSelectFunction": "cep",
+        "StreamConfig": "config",
+        "RestartStrategies": "runtime.supervisor",
+        "JobServer": "tenancy", "TenantPlan": "tenancy",
+        "TenantQuota": "tenancy",
+    }
 
-from .api.tuples import Tuple2, Tuple3, Tuple4  # noqa: E402
-from .api.timeapi import Time, TimeCharacteristic  # noqa: E402
-from .api.environment import StreamExecutionEnvironment  # noqa: E402
-from .api.watermarks import (  # noqa: E402
-    AssignerWithPeriodicWatermarks,
-    BoundedOutOfOrdernessTimestampExtractor,
-    Watermark,
-)
-from .api.functions import (  # noqa: E402
-    AggregateFunction,
-    FilterFunction,
-    KeySelector,
-    MapFunction,
-    ProcessWindowFunction,
-    ReduceFunction,
-)
-from .api.output import OutputTag  # noqa: E402
-from .analysis import Finding, PlanAnalysisError  # noqa: E402
-from .broadcast import (  # noqa: E402
-    BroadcastStream,
-    RuleDescriptor,
-    RuleParam,
-    RuleSet,
-    RuleUpdate,
-)
-from .cep import CEP, Pattern, PatternSelectFunction  # noqa: E402
-from .config import StreamConfig  # noqa: E402
-from .runtime.supervisor import RestartStrategies  # noqa: E402
-from .tenancy import JobServer, TenantPlan, TenantQuota  # noqa: E402
+    def __getattr__(name):
+        target = _LAZY_API.get(name)
+        if target is None:
+            raise AttributeError(name)
+        import importlib
+
+        import jax as _jax
+
+        _jax.config.update("jax_enable_x64", True)
+        mod = importlib.import_module("." + target, __name__)
+        val = getattr(mod, name)
+        globals()[name] = val
+        return val
+else:
+    import jax as _jax
+
+    # Java doubles / epoch-millisecond int64 timestamps need x64. TPU
+    # benchmark configs opt back into f32/i32 accumulators via
+    # StreamConfig.
+    _jax.config.update("jax_enable_x64", True)
+
+    from .api.tuples import Tuple2, Tuple3, Tuple4  # noqa: E402
+    from .api.timeapi import Time, TimeCharacteristic  # noqa: E402
+    from .api.environment import StreamExecutionEnvironment  # noqa: E402
+    from .api.watermarks import (  # noqa: E402
+        AssignerWithPeriodicWatermarks,
+        BoundedOutOfOrdernessTimestampExtractor,
+        Watermark,
+    )
+    from .api.functions import (  # noqa: E402
+        AggregateFunction,
+        FilterFunction,
+        KeySelector,
+        MapFunction,
+        ProcessWindowFunction,
+        ReduceFunction,
+    )
+    from .api.output import OutputTag  # noqa: E402
+    from .analysis import Finding, PlanAnalysisError  # noqa: E402
+    from .broadcast import (  # noqa: E402
+        BroadcastStream,
+        RuleDescriptor,
+        RuleParam,
+        RuleSet,
+        RuleUpdate,
+    )
+    from .cep import CEP, Pattern, PatternSelectFunction  # noqa: E402
+    from .config import StreamConfig  # noqa: E402
+    from .runtime.supervisor import RestartStrategies  # noqa: E402
+    from .tenancy import JobServer, TenantPlan, TenantQuota  # noqa: E402
 
 __version__ = "0.1.0"
 

@@ -207,6 +207,18 @@ CATALOG = {
             "docs/observability.md); check for renames after upgrades",
         ),
         Rule(
+            "TSM016", ERROR, "ingest_lanes misconfigured for this job",
+            "sharded host ingestion (StreamConfig.ingest_lanes > 1) "
+            "splits source frames across worker processes; a source "
+            "that cannot be split by line framing would be silently "
+            "forced back to one lane at runtime, lanes beyond the "
+            "host's core count contend instead of parallelise, and "
+            "multi-host execution always runs single-lane.",
+            "use a line-splittable source (SocketTextSource needs "
+            "raw=True), keep ingest_lanes <= host cores, or drop the "
+            "knob back to 1",
+        ),
+        Rule(
             "TSM020", WARN, "nondeterministic call in a user function",
             "time/random/datetime/uuid calls make replay diverge: a "
             "supervised restart reprocesses records from the last "

@@ -231,6 +231,53 @@ def check_nonreplayable_source_restart(ctx) -> Iterable[Finding]:
 
 
 @rule
+def check_ingest_lane_misconfig(ctx) -> Iterable[Finding]:
+    """TSM016: ingest_lanes settings the runtime would silently undo.
+
+    Mirrors the runtime gates in runtime/ingest.py:build_ingest_plane —
+    a non-splittable source or multi-host mesh forces lanes back to 1
+    with only a flight breadcrumb; this rule surfaces the same facts
+    before the job runs."""
+    lanes = getattr(ctx.cfg, "ingest_lanes", 1)
+    if lanes <= 1:
+        return
+    for node in ctx.nodes("source"):
+        src = node.params.get("source")
+        if src is not None and not getattr(src, "splittable", True):
+            yield make_finding(
+                "TSM016", node,
+                f"ingest_lanes={lanes} but source {type(src).__name__} "
+                "is not line-splittable: the runtime forces single-lane "
+                "ingestion and the extra lanes never run",
+            )
+    import os as _os
+
+    host_cores = _os.cpu_count() or 1
+    if lanes > host_cores:
+        yield make_finding(
+            "TSM016", None,
+            f"ingest_lanes={lanes} exceeds this host's {host_cores} "
+            "core(s): lane workers contend for cores instead of "
+            "parallelising the parse",
+            severity=WARN,
+        )
+    try:
+        import jax
+
+        procs = jax.process_count()
+    except Exception:
+        procs = 1
+    if procs > 1:
+        yield make_finding(
+            "TSM016", None,
+            f"ingest_lanes={lanes} under multi-host execution "
+            f"({procs} processes): sharded ingestion is single-host "
+            "only and will run with 1 lane",
+            severity=INFO,
+        )
+
+
+@rule
 def check_compaction_on_mesh(ctx) -> Iterable[Finding]:
     """TSM006: compaction_capacity on p>1 is silently ignored."""
     cfg = ctx.cfg

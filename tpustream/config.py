@@ -261,6 +261,24 @@ class StreamConfig:
     # against the round trip it was meant to amortize (ADVICE r5). Ask
     # for a bigger group by raising async_depth alongside fetch_group.
 
+    ingest_lanes: int = 1
+    # Sharded host ingestion (runtime/ingest.py): > 1 splits source
+    # frames round-robin across N lane worker PROCESSES, each running
+    # the compiled columnar parse plan (hostparse + native/_fastparse)
+    # over a shared-memory ring of length-framed batches and shipping
+    # transport-packed columns back. The merge point consumes frames in
+    # strict sequence order and reconciles per-lane intern tables and
+    # demotion chains, so output stays byte-identical to the default
+    # single-lane path and exactly-once recovery is unchanged (the
+    # source cursor replays un-merged frames). 1 (default) = today's
+    # inline host stage; no worker, no ring, no extra cost. Forced to 1
+    # under multi-host execution, when the job's host stage has no
+    # native columnar plan (fallback map, punctuated watermarks,
+    # computed keys), or when the source is not splittable — each with
+    # a flight breadcrumb (analyzer rule TSM016 flags these ahead of
+    # time). Lanes beyond the host's core count add scheduling overhead
+    # without parse throughput (TSM016 WARN).
+
     parse_ahead: int = 0
     # Source+parse pipelining depth: >0 moves the host stage (source
     # read, line skip on resume, parse + intern) onto its own thread
@@ -374,4 +392,13 @@ class StreamConfig:
                           "on every grouped fetch",
             })
             cfg = self.replace(fetch_group=eff)
+        if self.ingest_lanes < 1:
+            notes.append({
+                "knob": "ingest_lanes",
+                "requested": self.ingest_lanes,
+                "effective": 1,
+                "reason": "ingest_lanes must be >= 1; 1 is the inline "
+                          "single-lane host stage",
+            })
+            cfg = cfg.replace(ingest_lanes=1)
         return cfg, notes

@@ -342,9 +342,13 @@ class PlanEvaluator:
         native parser is unavailable for this plan."""
         if self._native is None:
             return None
-        base_vals, _bad = self._native.parse(data, n_lines)
-        if len(base_vals[0]) != n_lines:
-            return None  # blank lines etc.: let the python path decide
+        base_vals, bad = self._native.parse(data, n_lines)
+        if bad or len(base_vals[0]) != n_lines:
+            # bad fields zero-fill in the C kernel rather than raise, so
+            # a batch with ANY malformed line must take the strict python
+            # path — that is where poison records raise into the
+            # dead-letter quarantine instead of flowing on as zeros
+            return None
         return [
             np.asarray(self._eval_tree(t, base_vals, n_lines))
             for t in self._native_trees

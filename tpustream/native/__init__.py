@@ -26,23 +26,50 @@ _SO = os.path.join(_HERE, "_fastparse.so")
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_build_error: Optional[str] = None
+
+
+def _tail(text: bytes, limit: int = 400) -> str:
+    s = text.decode("utf-8", "replace").strip()
+    return s[-limit:] if len(s) > limit else s
 
 
 def _build() -> bool:
+    """Build the .so, Makefile first, then a portable g++ fallback.
+
+    The Makefile carries the tuned flags (-march=native); the fallback
+    drops them so a host whose toolchain rejects the tuned line still
+    gets A native parser rather than none. Never raises: on failure the
+    last compiler stderr is kept in ``_build_error`` for the executor's
+    flight breadcrumb and the numpy path takes over."""
+    global _build_error
     src = os.path.join(_HERE, "fastparse.cpp")
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", _SO],
-            check=True,
-            capture_output=True,
-        )
-        return True
-    except Exception:
-        return False
+    attempts = [
+        ["make", "-C", _HERE, "_fastparse.so"],
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", _SO],
+    ]
+    errors = []
+    for cmd in attempts:
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            _build_error = None
+            return True
+        except subprocess.CalledProcessError as e:
+            errors.append(f"{cmd[0]}: {_tail(e.stderr or e.stdout or b'')}")
+        except Exception as e:
+            errors.append(f"{cmd[0]}: {e}")
+    _build_error = "; ".join(errors) or "unknown build failure"
+    return False
+
+
+def build_error() -> Optional[str]:
+    """Why the native parser is unavailable (None when it is, or when
+    no build has been attempted yet)."""
+    return _build_error
 
 
 def _load():
-    global _lib, _tried
+    global _lib, _tried, _build_error
     with _lock:
         if _lib is not None or _tried:
             return _lib
@@ -54,8 +81,18 @@ def _load():
                 return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
+        except OSError as e:
+            # a pre-built .so from another toolchain (missing GLIBCXX
+            # symbols, wrong arch) dlopen-fails even though it is newer
+            # than the source: rebuild once against THIS toolchain
+            if not _build():
+                _build_error = f"dlopen: {e}; rebuild: {_build_error}"
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError as e2:
+                _build_error = f"dlopen after rebuild: {e2}"
+                return None
         lib.tsp_table_new.restype = ctypes.c_void_p
         lib.tsp_table_free.argtypes = [ctypes.c_void_p]
         lib.tsp_table_size.argtypes = [ctypes.c_void_p]
@@ -88,6 +125,7 @@ def _load():
             lib.tsp_parse_mt.restype = ctypes.c_int64
         except AttributeError:
             # stale pre-MT .so: keep the graceful-fallback contract
+            _build_error = "stale _fastparse.so missing tsp_parse_mt"
             return None
         _lib = lib
         return _lib

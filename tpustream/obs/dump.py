@@ -486,6 +486,14 @@ def _selftest() -> int:
     g.gauge("controller_async_depth").set(3)
     g.gauge("controller_objective_rows_per_s").set(123456.0)
     g.counter("controller_decisions_total").inc(4)
+    # sharded-ingestion series (runtime/ingest.py, docs/performance.md):
+    # per-lane parse counters / ring-occupancy gauges plus the merge
+    # stall histogram the IngestPlane mints through the same group path
+    lg = g.group(lane="0")
+    lg.counter("ingest_lane_records_total").inc(256)
+    lg.gauge("ingest_ring_occupancy").set(0.25)
+    g.group(lane="1").counter("ingest_lane_records_total").inc(240)
+    g.histogram("ingest_lane_stall_ms").observe(1.25)
     # multi-tenant fleet series (docs/multitenancy.md): the fleet-size
     # gauge plus per-tenant-labeled admission/quota/rule-version series
     # the JobServer mints through the same group path
@@ -682,6 +690,15 @@ def _selftest() -> int:
          "acme" in _tenants_text and "globex" in _tenants_text),
         ("tenants render carries the SLO verdicts",
          "CRIT" in _tenants_text and "OK" in _tenants_text),
+        ("render names the ingest-lane series",
+         "ingest_lane_records_total" in text
+         and "ingest_lane_stall_ms" in text),
+        ("prometheus carries the per-lane ingest counters",
+         'ingest_lane_records_total{job="selftest",lane="0"} 256' in prom
+         and 'ingest_lane_records_total{job="selftest",lane="1"} 240'
+         in prom),
+        ("prometheus carries the ingest ring gauge",
+         'ingest_ring_occupancy{job="selftest",lane="0"} 0.25' in prom),
         ("render names the analysis findings counter",
          "analysis_findings_total" in text),
         ("prometheus carries the per-code analysis findings",

@@ -25,7 +25,12 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-SPAN_KINDS = ("parse", "pack", "h2d", "dispatch", "fetch", "emit")
+# "lane_parse" is the ingest-lane worker's parse span (runtime/ingest.py
+# re-records it at the merge point with the worker-measured duration) —
+# appended LAST so the profiler's binding-stage gauge keeps its
+# historical index values for the original six stages.
+SPAN_KINDS = ("parse", "pack", "h2d", "dispatch", "fetch", "emit",
+              "lane_parse")
 
 
 class _Span:

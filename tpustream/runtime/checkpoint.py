@@ -150,6 +150,14 @@ class Checkpoint:
     # admitted/quota-rejected counters, slot capacity. The per-tenant
     # rule VECTORS ride rule_values["__tenant__"] above.
     tenancy: Optional[dict] = None
+    # sharded ingestion (runtime/ingest.py): the per-lane frame cursor
+    # at snapshot time — {lanes, merged_frames, lane_frames,
+    # host_frames}. Informational: exactly-once replay is carried by
+    # source_pos (frames past the merge are in it, frames still in a
+    # lane ring are not), so restore never consumes this; recovery
+    # tests assert against it. Optional key — older snapshots load as
+    # None, no format bump.
+    ingest: Optional[dict] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -315,6 +323,7 @@ def save_checkpoint(
     rule_values: Optional[dict] = None,
     rule_version: int = 0,
     tenancy: Optional[dict] = None,
+    ingest: Optional[dict] = None,
 ) -> str:
     """Snapshot to ``directory/ckpt-<source_pos>.npz`` (atomic
     write-to-.tmp + ``os.replace``); prunes to the ``keep`` newest
@@ -347,6 +356,7 @@ def save_checkpoint(
         "rule_values": rule_values,
         "rule_version": int(rule_version),
         "tenancy": tenancy,
+        "ingest": ingest,
         "checksum": _checksum(leaves),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(leaves)}
@@ -490,4 +500,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         rule_values=meta.get("rule_values"),
         rule_version=meta.get("rule_version", 0),
         tenancy=meta.get("tenancy"),
+        ingest=meta.get("ingest"),
     )

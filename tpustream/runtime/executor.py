@@ -2419,6 +2419,13 @@ def _run_attempt(env, sink_nodes) -> JobResult:
                 e, dump=getattr(env, "_supervision", None) is None
             )
         raise
+    finally:
+        # sharded ingestion clean-up: lane workers and their shared-
+        # memory rings die with the attempt, crashed or not, so a
+        # supervised restart never leaks a worker fleet per attempt
+        plane = env.__dict__.pop("_ingest_plane", None)
+        if plane is not None:
+            plane.close()
     job_obs = getattr(env.metrics, "job_obs", None)
     if job_obs is not None:
         job_obs.close()
@@ -2470,6 +2477,17 @@ def _execute_job(env, sink_nodes) -> JobResult:
     # knobs are part of this attempt's story, like config_resolved)
     for note in resolve_notes:
         job_obs.flight.record("config_clamped", **note)
+    # native parser status: when the Makefile/g++ build failed (or the
+    # .so is stale) the job silently runs the numpy parse path — leave
+    # a breadcrumb so a postmortem explains the throughput cliff
+    if job_obs.enabled:
+        from .. import native as _native_mod
+
+        if not _native_mod.available():
+            job_obs.flight.record(
+                "native_parse_unavailable",
+                error=_native_mod.build_error() or "build not attempted",
+            )
     # pre-flight analysis findings (stashed by execute_job; popped so a
     # supervised restart doesn't double-count): WARN/ERROR go to the
     # flight ring, every finding increments the per-code counter
@@ -2891,7 +2909,27 @@ def _execute_job(env, sink_nodes) -> JobResult:
             ),
         )
     prepared = map(_prepare, source_batches)
-    prefetched = cfg.parse_ahead > 0 and jax.process_count() == 1
+    # sharded host ingestion (runtime/ingest.py): lane worker processes
+    # parse frames in parallel; the merge point yields the SAME
+    # (sb, batch, wm_hint, hw) tuples in sequence order, so everything
+    # downstream — feed, H2D staging, checkpoints — is unchanged.
+    # _run_attempt closes the plane (env._ingest_plane) on any exit.
+    ingest_plane = None
+    if cfg.ingest_lanes > 1:
+        from .ingest import build_ingest_plane
+
+        ingest_plane = env._ingest_plane = build_ingest_plane(
+            host, cfg, plan, job_obs,
+            single_process=jax.process_count() == 1,
+            fault=fault, skip_lines=skip_lines,
+        )
+        if ingest_plane is not None:
+            prepared = ingest_plane.frames(source_batches, _prepare)
+    prefetched = (
+        cfg.parse_ahead > 0
+        and jax.process_count() == 1
+        and ingest_plane is None
+    )
     if prefetched:
         # source + parse on their own thread (the reference's source-
         # operator thread): batch N+1 parses while N crosses the link
@@ -3108,6 +3146,15 @@ def _execute_job(env, sink_nodes) -> JobResult:
                     tenancy=(
                         env._tenancy.state_dict()
                         if getattr(env, "_tenancy", None) is not None
+                        else None
+                    ),
+                    # sharded ingestion: the per-lane frame cursor at
+                    # this snapshot (frames the merge consumed; frames
+                    # still in a lane ring are not in source_pos either,
+                    # so recovery replays them exactly once)
+                    ingest=(
+                        ingest_plane.cursor()
+                        if ingest_plane is not None
                         else None
                     ),
                 )

@@ -1,0 +1,459 @@
+"""Sharded host ingestion: the IngestPlane.
+
+The single-lane host stage is one socket -> one parse thread -> one H2D
+lane; its measured single-stream wire ceiling (~531K rows/s, BENCH_r05)
+is the end-to-end flood bottleneck while the device sustains tens of
+millions of events/s. This module shards that host data plane the way
+Flink scales sources (parallel source subtasks feeding a partitioned
+exchange): ``StreamConfig.ingest_lanes`` worker processes
+(parallel/lanes.py) each own a shared-memory ring of length-framed
+batches, run the compiled columnar parse plan, and ship transport-packed
+columns back; the merge point below interleaves them deterministically.
+
+Determinism contract — the whole design hangs off it:
+
+* the producer assigns a SEQUENCE NUMBER to every source batch and
+  frames them round-robin (``seq % lanes``);
+* the merge consumes strictly in sequence order, so sink output is
+  byte-identical to the single-lane path regardless of worker timing;
+* per-lane interned-string ids are remapped onto the job's plan tables
+  AT THE MERGE, in frame order, so global id assignment order equals
+  the single-lane first-appearance order;
+* per-lane sticky transport demotion chains are lossless encodings
+  reconciled (exactly inverted) at the merge, so column values never
+  depend on where a lane's chain sits;
+* exactly-once recovery is unchanged: frames past the merge point are
+  reflected in the source cursor, frames still in a ring are not — a
+  restart replays them like any unread source data. Checkpoints record
+  the per-lane frame cursor (informational ``ingest`` meta).
+
+Frames the lanes cannot take (resume skip in progress, empty/final
+batches, blank lines defeating the native parser, oversized frames)
+fall back to the executor's ordinary inline ``_prepare`` path AT THEIR
+SEQUENCE POSITION, so the interleave — and therefore the output — stays
+exact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..parallel.lanes import LaneSpec, ShmRing, spawn_lane, unpack_columns
+from ..records import STR, Batch, Column
+from .metrics import Stopwatch
+
+#: default per-direction shared-memory ring bytes per lane
+#: (override via StreamConfig.extra["ingest_ring_bytes"])
+DEFAULT_RING_BYTES = 8 << 20
+
+#: producer look-ahead bound, in frames past the merge cursor — keeps an
+#: eager source from buffering the whole stream in host-frame metadata
+_MAX_AHEAD_FRAMES = 4
+
+
+def build_ingest_plane(
+    host, cfg, plan, job_obs, single_process: bool,
+    fault=None, skip_lines: int = 0,
+) -> Optional["IngestPlane"]:
+    """Gate + construct: an IngestPlane when ``cfg.ingest_lanes`` > 1 and
+    the job can take it, else None with a flight breadcrumb naming the
+    reason (the analyzer's TSM016 flags the same conditions pre-flight).
+    """
+    lanes = int(cfg.ingest_lanes)
+    if lanes <= 1:
+        return None
+
+    def _disabled(reason: str) -> None:
+        job_obs.flight.record(
+            "ingest_lanes_disabled", lanes=lanes, reason=reason
+        )
+        return None
+
+    if not single_process:
+        return _disabled("multiprocess")
+    if not getattr(plan.source, "splittable", True):
+        return _disabled("source_not_splittable")
+    # force the raw-eval build (the same lazy hook process_raw uses):
+    # lanes need the SAME eligibility — one native parse-map plan, no
+    # computed key, no punctuated watermarks
+    if not host._raw_eval_built:
+        host._raw_eval = host._build_raw_eval()
+        host._raw_eval_built = True
+    if host._raw_eval is None:
+        return _disabled("no_native_columnar_plan")
+    exprs: list = []
+    kinds: list = []
+    str_slots: list = []
+    tables: list = []  # GLOBAL plan tables aligned with exprs
+    if host._raw_has_ts:
+        exprs.append(plan.ts_expr)
+        kinds.append("i64")
+        str_slots.append(False)
+        tables.append(None)
+    hop = plan.host_ops[0]
+    exprs.extend(hop.plan.outputs)
+    kinds.extend(plan.record_kinds)
+    for k, t in zip(plan.record_kinds, plan.tables):
+        str_slots.append(k == STR)
+        tables.append(t if k == STR else None)
+    plane = IngestPlane(
+        lanes=lanes,
+        spec=LaneSpec(exprs, kinds, str_slots),
+        global_tables=tables,
+        has_ts=host._raw_has_ts,
+        record_kinds=list(plan.record_kinds),
+        record_tables=list(plan.tables),
+        job_obs=job_obs,
+        fault=fault,
+        skip_lines=skip_lines,
+        ring_bytes=int(
+            (cfg.extra or {}).get("ingest_ring_bytes", DEFAULT_RING_BYTES)
+        ),
+    )
+    job_obs.flight.record("ingest_lanes_enabled", lanes=lanes)
+    return plane
+
+
+class IngestPlane:
+    """N lane worker processes + the deterministic merge point."""
+
+    def __init__(
+        self, lanes: int, spec: LaneSpec, global_tables: list,
+        has_ts: bool, record_kinds: list, record_tables: list,
+        job_obs, fault, skip_lines: int, ring_bytes: int,
+    ):
+        import multiprocessing as mp
+
+        self.lanes = lanes
+        self.spec = spec
+        self._global_tables = global_tables
+        self._has_ts = has_ts
+        self._record_kinds = record_kinds
+        self._record_tables = record_tables
+        self._job_obs = job_obs
+        self._fault = fault
+        self._skip_left = int(skip_lines)
+
+        # fork when the platform has it: the worker inherits the already-
+        # imported parse modules and skips spawn's re-exec of the user's
+        # __main__ (the child never touches jax — it only runs the
+        # numpy/native parse loop). spawn is the fallback; there the
+        # TPUSTREAM_LANE_WORKER gate keeps the child's package import
+        # light and the gate's lazy __getattr__ keeps user scripts
+        # importable.
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:
+            ctx = mp.get_context("spawn")
+        self._stop_ev = ctx.Event()
+        self._in_rings: List[ShmRing] = []
+        self._out_rings: List[ShmRing] = []
+        self._in_qs = []
+        self._out_qs = []
+        self._ack_in_qs = []
+        self._ack_out_qs = []
+        self._workers = []
+        for i in range(lanes):
+            in_ring = ShmRing(ring_bytes)
+            out_ring = ShmRing(ring_bytes)
+            in_q, out_q = ctx.Queue(), ctx.Queue()
+            ack_in, ack_out = ctx.Queue(), ctx.Queue()
+            self._in_rings.append(in_ring)
+            self._out_rings.append(out_ring)
+            self._in_qs.append(in_q)
+            self._out_qs.append(out_q)
+            self._ack_in_qs.append(ack_in)
+            self._ack_out_qs.append(ack_out)
+            self._workers.append(
+                spawn_lane(
+                    ctx, i, spec,
+                    (in_ring.name, ring_bytes, out_ring.name, ring_bytes,
+                     in_q, out_q, ack_in, ack_out, self._stop_ev),
+                )
+            )
+
+        # merge/producer shared state
+        self._cv = threading.Condition()
+        self._meta: dict = {}         # seq -> ("host"|"lane", SourceBatch)
+        self._produced = 0
+        self._merged = 0
+        self._eos: Optional[int] = None
+        self._perror = None           # (seq, exception) from the producer
+        self._producer: Optional[threading.Thread] = None
+        self._closed = False
+        self._lane_merged = [0] * lanes
+        self._host_frames = 0
+        # per-(lane, str-slot) id remap: lane-local id -> global plan id
+        self._remaps = [
+            [[] if s else None for s in spec.str_slots] for _ in range(lanes)
+        ]
+
+        enabled = getattr(job_obs, "enabled", False)
+        self._rec_counters = [
+            job_obs.group.group(lane=str(i)).counter(
+                "ingest_lane_records_total"
+            ) if enabled else None
+            for i in range(lanes)
+        ]
+        self._occ_gauges = [
+            job_obs.group.group(lane=str(i)).gauge("ingest_ring_occupancy")
+            if enabled else None
+            for i in range(lanes)
+        ]
+        self._stall_hist = (
+            job_obs.histogram("ingest_lane_stall_ms") if enabled else None
+        )
+
+    # -- producer -----------------------------------------------------------
+
+    def _frame_payload(self, sb):
+        """(data, n) when the batch can ship to a lane, else None. Lines
+        render exactly the way PlanEvaluator.__call__ would feed the
+        native parser, so lane results match the inline path bit for
+        bit."""
+        if sb.final or sb.n_records == 0:
+            return None
+        if sb.raw is not None:
+            return sb.raw, sb.n_raw
+        return "\n".join(sb.lines).encode("utf-8"), len(sb.lines)
+
+    def _producer_main(self, source_batches) -> None:
+        seq = 0
+        try:
+            for sb in source_batches:
+                with self._cv:
+                    while (
+                        self._produced - self._merged
+                        >= _MAX_AHEAD_FRAMES * self.lanes
+                        and not self._closed
+                    ):
+                        self._cv.wait(0.2)
+                    if self._closed:
+                        return
+                mode = "host"
+                if self._skip_left > 0:
+                    # resume replay: the executor's _prepare owns the
+                    # line-exact trim; frames route inline until the
+                    # skip is exhausted
+                    self._skip_left -= min(self._skip_left, sb.n_records)
+                else:
+                    payload = self._frame_payload(sb)
+                    if payload is not None:
+                        data, n = payload
+                        lane = seq % self.lanes
+                        ring = self._in_rings[lane]
+                        if ring.fits(len(data)):
+                            off, cost = ring.write(
+                                data,
+                                lambda: self._credit(
+                                    self._ack_in_qs[lane]
+                                ),
+                            )
+                            self._in_qs[lane].put(
+                                ("frame", seq, off, cost, len(data), n)
+                            )
+                            g = self._occ_gauges[lane]
+                            if g is not None:
+                                g.set(ring.size - ring.free)
+                            mode = "lane"
+                with self._cv:
+                    self._meta[seq] = (mode, sb)
+                    self._produced += 1
+                    self._cv.notify_all()
+                seq += 1
+            with self._cv:
+                self._eos = seq
+                self._cv.notify_all()
+        except BaseException as e:
+            with self._cv:
+                self._perror = (seq, e)
+                self._cv.notify_all()
+
+    def _credit(self, q):
+        """One ring credit, aborting when the plane is closing."""
+        import queue as _queue
+
+        while True:
+            try:
+                return q.get(timeout=0.2)
+            except _queue.Empty:
+                if self._closed or self._stop_ev.is_set():
+                    raise RuntimeError("ingest plane closed")
+
+    # -- merge --------------------------------------------------------------
+
+    def frames(self, source_batches, prepare) -> Iterator[tuple]:
+        """Yield ``(sb, batch, wm_hint, hw)`` in strict sequence order —
+        drop-in for the executor's ``map(_prepare, source_batches)``.
+        ``prepare`` is that same inline closure; host-routed frames take
+        it unchanged (resume skip, quarantine, fault hooks included).
+        """
+        self._producer = threading.Thread(
+            target=self._producer_main, args=(source_batches,),
+            name="tpustream-ingest-producer", daemon=True,
+        )
+        self._producer.start()
+        try:
+            seq = 0
+            while True:
+                with self._cv:
+                    while (
+                        seq not in self._meta
+                        and (self._eos is None or seq < self._eos)
+                        and self._perror is None
+                    ):
+                        self._cv.wait(0.5)
+                        self._check_workers()
+                    if seq not in self._meta:
+                        if self._perror is not None:
+                            raise self._perror[1]
+                        break  # end of stream
+                    mode, sb = self._meta.pop(seq)
+                if mode == "host":
+                    self._host_frames += 1
+                    yield prepare(sb)
+                else:
+                    yield self._merge_lane_frame(seq, sb, prepare)
+                with self._cv:
+                    self._merged += 1
+                    self._cv.notify_all()
+                seq += 1
+        finally:
+            self.close()
+
+    def _check_workers(self) -> None:
+        for i, w in enumerate(self._workers):
+            if not w.is_alive() and w.exitcode not in (0, None):
+                raise RuntimeError(
+                    f"ingest lane {i} worker died (exit {w.exitcode})"
+                )
+
+    def _next_from_lane(self, lane: int):
+        import queue as _queue
+
+        q = self._out_qs[lane]
+        while True:
+            try:
+                return q.get(timeout=0.5)
+            except _queue.Empty:
+                self._check_workers()
+
+    def _merge_lane_frame(self, seq: int, sb, prepare):
+        t_wait = time.perf_counter()
+        desc = self._next_from_lane(seq % self.lanes)
+        if self._stall_hist is not None:
+            self._stall_hist.observe(
+                (time.perf_counter() - t_wait) * 1000.0
+            )
+        if desc[0] == "err":
+            raise RuntimeError(
+                f"ingest lane {desc[1]} failed: {desc[2]}"
+            )
+        if desc[0] == "host":
+            # the lane could not take this frame (blank lines defeating
+            # the native plan, oversized packed output): inline parse at
+            # the same sequence position keeps the interleave exact
+            if desc[1] != seq:
+                raise RuntimeError(
+                    f"ingest lane frame out of order: expected seq {seq}, "
+                    f"got {desc[1]}"
+                )
+            self._host_frames += 1
+            return prepare(sb)
+        _, dseq, off, cost, nbytes, n, metas, new_strings, dur = desc
+        if dseq != seq:
+            raise RuntimeError(
+                f"ingest lane frame out of order: expected seq {seq}, "
+                f"got {dseq}"
+            )
+        lane = seq % self.lanes
+        job_obs = self._job_obs
+        with job_obs.tracer.span("parse"), Stopwatch() as hw:
+            if self._fault is not None:
+                self._fault("parse")
+            payload = self._out_rings[lane].read(off, nbytes)
+            self._ack_out_qs[lane].put(cost)
+            cols = unpack_columns(metas, self.spec.kinds, payload, n)
+            # lane-local interned ids -> the job's plan tables, extended
+            # in frame order: global id assignment order equals the
+            # single-lane first-appearance order
+            remaps = self._remaps[lane]
+            for j, news in enumerate(new_strings):
+                if remaps[j] is None:
+                    continue
+                if news:
+                    table = self._global_tables[j]
+                    remaps[j].extend(table.intern(s) for s in news)
+                cols[j] = np.asarray(remaps[j], dtype=np.int32)[cols[j]]
+            ts = None
+            if self._has_ts:
+                ts = np.asarray(cols[0], dtype=np.int64)
+                cols = cols[1:]
+            columns = [
+                Column(k, c, t)
+                for k, c, t in zip(
+                    self._record_kinds, cols, self._record_tables
+                )
+            ]
+            batch = Batch(n, columns, ts=ts, proc_ts=sb.proc_ts)
+        if job_obs.tracer.enabled:
+            # the worker-side parse span, re-anchored to this clock so
+            # the profiler's binding-stage attribution can name the
+            # ingest plane
+            now = time.perf_counter()
+            job_obs.tracer._record(
+                "lane_parse", -1, f"lane{lane}", now - dur, dur
+            )
+        c = self._rec_counters[lane]
+        if c is not None:
+            c.inc(n)
+        self._lane_merged[lane] += 1
+        return sb, batch, None, hw
+
+    # -- checkpoint / shutdown ---------------------------------------------
+
+    def cursor(self) -> dict:
+        """Per-lane frame cursor for checkpoint meta: which frames the
+        merge has consumed. Frames still in a ring are NOT in the source
+        cursor either, so recovery replays them exactly once."""
+        return {
+            "lanes": self.lanes,
+            "merged_frames": self._merged,
+            "lane_frames": list(self._lane_merged),
+            "host_frames": self._host_frames,
+        }
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._stop_ev.set()
+        for q in self._in_qs:
+            try:
+                q.put(("stop",))
+            except Exception:
+                pass
+        if self._producer is not None:
+            self._producer.join(timeout=3.0)
+        for w in self._workers:
+            w.join(timeout=5.0)
+        for w in self._workers:
+            if w.is_alive():
+                w.terminate()
+                w.join(timeout=2.0)
+        for q in (
+            self._in_qs + self._out_qs + self._ack_in_qs + self._ack_out_qs
+        ):
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:
+                pass
+        for r in self._in_rings + self._out_rings:
+            r.close()

@@ -100,6 +100,17 @@ class Source:
     # stream).
     replayable = True
 
+    # Whether the stream can be SPLIT across ingest lanes
+    # (StreamConfig.ingest_lanes > 1, runtime/ingest.py): the producer
+    # frames each SourceBatch as one newline-delimited byte block and
+    # deals blocks round-robin to lane worker processes. Any source
+    # whose batches carry raw bytes or decodable lines qualifies (the
+    # replay/iterable sources do); a line-mode socket does not — its
+    # per-line Python queue IS the single-stream ceiling the lanes
+    # exist to break, so the analyzer (TSM016) demands ``raw=True``
+    # there instead of silently re-serializing.
+    splittable = True
+
     def batches(self, batch_size: int, max_delay_ms: float) -> Iterator[SourceBatch]:
         raise NotImplementedError  # pragma: no cover
 
@@ -223,6 +234,10 @@ class SocketTextSource(Source):
         self.port = port
         self.idle_tick_ms = idle_tick_ms
         self.raw = raw
+        # raw mode queues length-framed byte blocks — the framing
+        # producer ingest lanes shard; line mode's per-line queue is
+        # itself the single-stream bottleneck, so it is not splittable
+        self.splittable = raw
         # line mode: items are lines (~bytes each); raw mode: items are
         # up-to-1MB blocks, so the bound is a BYTE budget (~64 MB), not
         # a count sized for lines
