@@ -147,9 +147,26 @@ def test_transport_empty_and_nan_columns():
     metas, payload = pack_columns(cols, kinds, sticky)
     out = unpack_columns(metas, kinds, payload, 0)
     assert len(out[0]) == 0
-    # NaN round-trips through f32 (equal_nan packing check)
+    # the canonical NaN round-trips through f32 BIT-exactly, so it demotes
     assert metas[1][0] == "f32"
-    assert np.array_equal(out[1], cols[1], equal_nan=True)
+    assert np.array_equal(
+        out[1].view(np.int64), cols[1].view(np.int64)
+    )
+
+
+def test_transport_f64_nan_payload_rides_raw():
+    # a NaN with non-default payload bits is VALUE-equal after an f32
+    # round trip (any NaN == any NaN under equal_nan) but not BIT-equal:
+    # it must not demote, or transport would rewrite its bit pattern
+    weird_nan = np.array([0x7FF8000000000001], dtype=np.int64).view(
+        np.float64
+    )
+    cols = [np.concatenate([weird_nan, [1.0]])]
+    sticky = [0]
+    metas, payload = pack_columns(cols, [F64], sticky)
+    assert metas[0][0] == "raw"
+    out = unpack_columns(metas, [F64], payload, 2)
+    assert np.array_equal(out[0].view(np.int64), cols[0].view(np.int64))
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +199,128 @@ def test_shm_ring_write_read_credit_and_wrap():
         assert ring.fits(64 - ring.HEADER) and not ring.fits(64)
     finally:
         ring.close()
+
+
+def test_shm_ring_large_frame_after_wrap_drains_and_resets():
+    """A frame larger than the space past head wraps; when the wrap cost
+    (frame + skipped tail) exceeds the whole ring, the writer must drain
+    fully and restart at offset 0 instead of waiting for credit that can
+    never arrive (regression: this used to deadlock the lane and hang
+    the merge)."""
+    ring = ShmRing(1000)
+    try:
+        credits = []
+
+        def wait_credit():
+            assert credits, "ring blocked with no outstanding credit"
+            return credits.pop(0)
+
+        off1, cost1 = ring.write(b"a" * 400, wait_credit)
+        assert ring.read(off1, 400) == b"a" * 400
+        credits.append(cost1)
+        # 600B frame at head 408: wrap cost would be 608 + 592 = 1200,
+        # more than the ring itself — free can never satisfy it
+        off2, cost2 = ring.write(b"b" * 600, wait_credit)
+        assert (off2, cost2) == (0, ring.HEADER + 600)
+        assert ring.read(off2, 600) == b"b" * 600
+        assert not credits, "drain must consume the pending credit"
+        # and the ring keeps working from the reset head
+        credits.append(cost2)
+        off3, cost3 = ring.write(b"c" * 900, wait_credit)
+        assert off3 == 0 and ring.read(off3, 900) == b"c" * 900
+    finally:
+        ring.close()
+
+
+# ---------------------------------------------------------------------------
+# lane worker protocol: oversized frames must not skew the string remap
+# ---------------------------------------------------------------------------
+def test_worker_oversized_frame_keeps_string_remap_aligned():
+    """When a packed payload cannot ever fit the output ring the worker
+    host-routes the frame; the strings that frame interned must NOT be
+    marked shipped — they ride out with the lane's next shipped frame,
+    so the merge's lane->global remap stays aligned (regression: shipped
+    advanced before the fits() check, silently corrupting every later
+    frame's string ids)."""
+    import queue
+    import threading
+
+    from tpustream.hostparse import PExpr
+    from tpustream.parallel.lanes import LaneSpec, lane_worker_main
+
+    spec = LaneSpec(
+        exprs=[PExpr.field(" ", 0), PExpr("parse_f64", (PExpr.field(" ", 1),))],
+        kinds=[STR, F64],
+        str_slots=[True, False],
+    )
+    ev, _ = spec.build_evaluator()
+    if ev is None:
+        pytest.skip("native parser unavailable")
+    in_ring = ShmRing(1 << 16)
+    # 64-byte output ring: an 8-byte header leaves 56 payload bytes, so
+    # frame 0 below (30 rows -> 60B i16 + 120B f32) can NEVER fit
+    out_ring = ShmRing(64)
+    in_q, out_q = queue.Queue(), queue.Queue()
+    ack_in, ack_out = queue.Queue(), queue.Queue()
+    stop_ev = threading.Event()
+    worker = threading.Thread(
+        target=lane_worker_main,
+        args=(0, spec, in_ring.name, in_ring.size, out_ring.name,
+              out_ring.size, in_q, out_q, ack_in, ack_out, stop_ev),
+        daemon=True,
+    )
+    worker.start()
+    try:
+        def send(seq, lines):
+            data = "\n".join(lines).encode("utf-8")
+            off, cost = in_ring.write(data, lambda: ack_in.get(timeout=10))
+            in_q.put(("frame", seq, off, cost, len(data), len(lines)))
+
+        # frame 0: 30 distinct strings, packed payload 180B > 56B
+        send(0, [f"s{i} {i}.5" for i in range(30)])
+        reply = out_q.get(timeout=10)
+        assert reply == ("host", 0)
+        # frame 1: reuses s0/s1 and interns s30/s31; fits (24B)
+        send(1, ["s0 0.5", "s30 1.5", "s1 2.5", "s31 3.5"])
+        reply = out_q.get(timeout=10)
+        assert reply[0] == "frame" and reply[1] == 1, reply
+        _, _, off, cost, nbytes, n, metas, new_strings, _ = reply
+        # the host-routed frame's 30 strings ship here, ahead of the new
+        # ones, in first-seen order — exactly the lane-local id order
+        assert new_strings[0] == [f"s{i}" for i in range(30)] + ["s30", "s31"]
+        assert new_strings[1] is None
+        payload = out_ring.read(off, nbytes)
+        ack_out.put(cost)
+        cols = unpack_columns(metas, spec.kinds, payload, n)
+        assert [new_strings[0][i] for i in cols[0]] == [
+            "s0", "s30", "s1", "s31"
+        ]
+    finally:
+        in_q.put(("stop",))
+        stop_ev.set()
+        worker.join(timeout=10)
+        in_ring.close()
+        out_ring.close()
+
+
+def test_merge_remap_grow_array():
+    """The merge-side lane->global remap appends into a grow-by-doubling
+    int32 array and gathers through the live prefix (a plain list would
+    re-materialize O(all strings) per frame — quadratic over a stream)."""
+    from tpustream.runtime.ingest import _Remap
+
+    r = _Remap()
+    expect = []
+    for start in range(0, 1200, 100):
+        ids = list(range(start * 7, (start + 100) * 7, 7))
+        r.extend(ids)
+        expect.extend(ids)
+        got = r.view()
+        assert got.dtype == np.int32 and got.tolist() == expect
+    assert np.array_equal(
+        r.view()[np.array([0, 599, 1199])],
+        np.array([expect[0], expect[599], expect[1199]]),
+    )
 
 
 # ---------------------------------------------------------------------------

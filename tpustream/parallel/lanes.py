@@ -96,12 +96,22 @@ class ShmRing:
         may raise to abort) until the ring has room.
         """
         nbytes = len(payload)
-        cost = self.write_cost(nbytes)
-        while self.free < cost:
-            self.free += wait_credit()
         need = self.HEADER + nbytes
-        if self.head + need > self.size:
+        cost = self.write_cost(nbytes)
+        if cost > self.size:
+            # wrap tail + frame exceeds the ring (need > head): no
+            # amount of acked credit can ever cover it from this head.
+            # Drain completely, restart at 0, and charge the frame
+            # alone — the abandoned tail holds only consumed frames.
+            while self.free < self.size:
+                self.free += wait_credit()
             self.head = 0
+            cost = need
+        else:
+            while self.free < cost:
+                self.free += wait_credit()
+            if self.head + need > self.size:
+                self.head = 0
         off = self.head
         buf = self.shm.buf
         _FRAME_HEADER.pack_into(buf, off, nbytes)
@@ -163,8 +173,11 @@ def pack_columns(cols: List[np.ndarray], kinds: List[str], sticky: List[int]):
         elif k == F64:
             c = np.ascontiguousarray(c, dtype=np.float64)
             narrow = c.astype(np.float32)
+            # demote only on a BIT-exact round trip: value equality (even
+            # with equal_nan) would demote NaNs whose payload bits f32
+            # truncates, breaking the bit-for-bit transport guarantee
             if lvl <= 0 and np.array_equal(
-                narrow.astype(np.float64), c, equal_nan=True
+                narrow.astype(np.float64).view(np.int64), c.view(np.int64)
             ):
                 mode, buf = "f32", narrow
             else:
@@ -308,6 +321,13 @@ def lane_worker_main(
                 out_q.put(("host", seq))
                 continue
             metas, payload = pack_columns(cols, spec.kinds, sticky)
+            if not out_ring.fits(len(payload)):
+                # host-route BEFORE the shipped bookkeeping: the strings
+                # this frame interned ride out with the lane's next
+                # shipped frame (same as the cols-is-None path), keeping
+                # the merge's lane->global remap aligned
+                out_q.put(("host", seq))
+                continue
             new_strings = []
             for j, t in enumerate(tables):
                 if t is None:
@@ -316,9 +336,6 @@ def lane_worker_main(
                     new_strings.append(t._to_str[shipped[j] :])
                     shipped[j] = len(t._to_str)
             dur = time.perf_counter() - t0
-            if not out_ring.fits(len(payload)):
-                out_q.put(("host", seq))
-                continue
             off2, cost2 = out_ring.write(
                 payload, lambda: _drain_credit(ack_out_q, stop_ev)
             )

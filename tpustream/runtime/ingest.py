@@ -55,6 +55,35 @@ DEFAULT_RING_BYTES = 8 << 20
 _MAX_AHEAD_FRAMES = 4
 
 
+class _Remap:
+    """Lane-local string id -> global plan id, kept as a grow-by-doubling
+    int32 array so the per-frame gather indexes a live prefix. A plain
+    list re-materialized with np.asarray per frame would be O(total
+    strings interned) per frame per str column — quadratic over a
+    long-running stream with a growing intern table."""
+
+    __slots__ = ("_buf", "_n")
+
+    def __init__(self):
+        self._buf = np.empty(256, dtype=np.int32)
+        self._n = 0
+
+    def extend(self, ids) -> None:
+        m = len(ids)
+        if self._n + m > self._buf.shape[0]:
+            cap = self._buf.shape[0]
+            while cap < self._n + m:
+                cap *= 2
+            buf = np.empty(cap, dtype=np.int32)
+            buf[: self._n] = self._buf[: self._n]
+            self._buf = buf
+        self._buf[self._n : self._n + m] = ids
+        self._n += m
+
+    def view(self) -> np.ndarray:
+        return self._buf[: self._n]
+
+
 def build_ingest_plane(
     host, cfg, plan, job_obs, single_process: bool,
     fault=None, skip_lines: int = 0,
@@ -189,7 +218,8 @@ class IngestPlane:
         self._host_frames = 0
         # per-(lane, str-slot) id remap: lane-local id -> global plan id
         self._remaps = [
-            [[] if s else None for s in spec.str_slots] for _ in range(lanes)
+            [_Remap() if s else None for s in spec.str_slots]
+            for _ in range(lanes)
         ]
 
         enabled = getattr(job_obs, "enabled", False)
@@ -387,8 +417,8 @@ class IngestPlane:
                     continue
                 if news:
                     table = self._global_tables[j]
-                    remaps[j].extend(table.intern(s) for s in news)
-                cols[j] = np.asarray(remaps[j], dtype=np.int32)[cols[j]]
+                    remaps[j].extend([table.intern(s) for s in news])
+                cols[j] = remaps[j].view()[cols[j]]
             ts = None
             if self._has_ts:
                 ts = np.asarray(cols[0], dtype=np.int64)
