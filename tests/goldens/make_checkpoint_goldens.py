@@ -1,0 +1,90 @@
+"""Regenerate the checked-in checkpoint-format golden fixtures.
+
+Runs GOLDEN_JOB (a tiny deterministic checkpointed chapter-2 rolling
+max) and keeps its final snapshot four ways:
+
+* ``ckpt-fv10.npz`` — exactly as this build writes it (FORMAT_VERSION)
+* ``ckpt-fv08.npz`` / ``ckpt-fv09.npz`` — the same payload with the
+  meta version rewritten down (the ``_rewrite_format_version``
+  technique from tests/test_recovery.py: payload and checksum stay
+  valid, ONLY the format version mismatches — simulating a snapshot
+  written by the pre-supervision / pre-dynamic-rules builds)
+* ``ckpt-fv11.npz`` — a version this build does not know yet
+
+tests/test_schema_audit.py asserts the state-layout auditor's verdict
+on each fixture matches what ``validate_checkpoint`` /
+``latest_checkpoint`` / a real restore actually do. Regenerate (only
+needed after a deliberate FORMAT_VERSION bump) with::
+
+    JAX_PLATFORMS=cpu python tests/goldens/make_checkpoint_goldens.py
+"""
+
+import glob
+import json
+import os
+import shutil
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# enough lines for several batch_size=2 interval-1 snapshots
+LINES = [
+    f"15634520{i % 60:02d} 10.8.22.{i % 3} cpu{i % 2} {(i * 7) % 100}.5"
+    for i in range(12)
+]
+
+
+def build_env(ckdir):
+    """The golden job: chapter-2 rolling max over a replay source, one
+    snapshot per batch. Must stay byte-stable across regenerations."""
+    from tpustream import StreamExecutionEnvironment
+    from tpustream.config import StreamConfig
+    from tpustream.jobs.chapter2_max import build
+
+    env = StreamExecutionEnvironment(StreamConfig(
+        batch_size=2,
+        checkpoint_dir=str(ckdir),
+        checkpoint_interval_batches=1,
+    ))
+    build(env, env.from_collection(LINES)).collect()
+    return env
+
+
+def rewrite_format_version(path, version):
+    import numpy as np
+
+    from tpustream.runtime.checkpoint import _META_KEY
+
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+    meta["version"] = version
+    with open(path, "wb") as f:
+        np.savez(f, **arrays, **{_META_KEY: np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)})
+
+
+def main():
+    from tpustream.runtime.checkpoint import FORMAT_VERSION
+
+    assert FORMAT_VERSION == 10, (
+        f"FORMAT_VERSION moved to {FORMAT_VERSION}: re-point the fixture "
+        "names/versions below and update tests/test_schema_audit.py"
+    )
+    d = tempfile.mkdtemp()
+    env = build_env(d)
+    env.execute("golden-checkpoint")
+    newest = sorted(glob.glob(os.path.join(d, "ckpt-*.npz")))[-1]
+    current = os.path.join(HERE, "ckpt-fv10.npz")
+    shutil.copy(newest, current)
+    for v in (8, 9, 11):
+        p = os.path.join(HERE, f"ckpt-fv{v:02d}.npz")
+        shutil.copy(current, p)
+        rewrite_format_version(p, v)
+    for n in sorted(os.listdir(HERE)):
+        if n.endswith(".npz"):
+            print(n, os.path.getsize(os.path.join(HERE, n)), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -634,10 +634,93 @@ def test_catalog_is_stable():
         "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
         "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
         "TSM013", "TSM014", "TSM015", "TSM016", "TSM020", "TSM021",
-        "TSM022", "TSM023", "TSM024",
+        "TSM022", "TSM023", "TSM024", "TSM025", "TSM030", "TSM031",
+        "TSM032", "TSM033", "TSM034", "TSM040", "TSM041", "TSM042",
+        "TSM043", "TSM044", "TSM045", "TSM046", "TSM047",
     }
     assert expected <= set(CATALOG)
     for code, rule in CATALOG.items():
         assert rule.code == code
         assert rule.severity in (ERROR, WARN, INFO)
         assert rule.title and rule.rationale and rule.fix_hint
+
+
+# ---------------------------------------------------------------------------
+# schema inference over the whole tutorial fleet + machine formats
+# ---------------------------------------------------------------------------
+
+
+CHAPTERS = (
+    "chapter1_threshold", "chapter2_avg", "chapter2_max",
+    "chapter2_median", "chapter3_bandwidth",
+    "chapter3_bandwidth_eventtime", "chapter4_cep_alert",
+    "chapter5_dynamic_rules", "chapter6_tenant_fleet",
+)
+
+
+def test_all_chapters_schema_clean():
+    """End-to-end schema inference over every chapter job: zero TSM03x
+    findings, and the chapter-1/chapter-3 sink schemas stay pinned
+    (they are the tutorial's documented record shapes)."""
+    import importlib
+
+    from tpustream.analysis import infer_schemas
+
+    schema_codes = {"TSM030", "TSM031", "TSM032", "TSM033", "TSM034"}
+    sink_kinds = {}
+    for ch in CHAPTERS:
+        mod = importlib.import_module(f"tpustream.jobs.{ch}")
+        env = mod.lint_env()
+        found = set(codes(env.analyze())) & schema_codes
+        assert not found, f"{ch}: unexpected schema findings {found}"
+        rep = infer_schemas(env)
+        sink_kinds[ch] = rep.sink.kinds if rep.sink is not None else None
+    assert sink_kinds["chapter1_threshold"] == ["str", "str", "f64"]
+    assert sink_kinds["chapter3_bandwidth"] == ["str", "i64"]
+
+
+def test_lint_cli_json_round_trips_catalog():
+    """--format json is the CI contract: one parseable document whose
+    finding records carry exactly the stable keys, with codes/severities
+    that round-trip against the CATALOG."""
+    import json as _json
+
+    out = io.StringIO()
+    assert lint_main(["--format", "json"], out=out) == 0
+    doc = _json.loads(out.getvalue())
+    assert doc["exit"] == 0
+    assert {r["module"].rsplit(".", 1)[1] for r in doc["modules"]} == set(
+        CHAPTERS
+    )
+    for rec in doc["modules"]:
+        assert rec["status"] == "ok"
+        for f in rec["findings"]:
+            assert set(f) == {
+                "code", "severity", "node", "message", "fix_hint",
+            }
+            assert f["code"] in CATALOG
+            assert f["severity"] == CATALOG[f["code"]].severity
+
+
+def test_lint_cli_github_annotations(tmp_path, monkeypatch):
+    (tmp_path / "ghjob.py").write_text(textwrap.dedent(
+        """
+        from tpustream import StreamExecutionEnvironment
+        from tpustream.api.datastream import KeyedStream
+
+        def lint_env():
+            env = StreamExecutionEnvironment.get_execution_environment()
+            stream = env.from_collection([])
+            KeyedStream(env, stream.node).max(0).print()
+            return env
+        """
+    ))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    out = io.StringIO()
+    assert lint_main(["ghjob", "--format", "github"], out=out) == 1
+    lines = [l for l in out.getvalue().splitlines() if l]
+    assert any(
+        l.startswith("::error title=TSM001 (ghjob)::") for l in lines
+    )
+    # annotations are single-line by construction
+    assert all(l.startswith("::") for l in lines)

@@ -133,3 +133,77 @@ def test_multithreaded_parse_identical_to_serial():
     for c1, c4 in zip(cols1, cols4):
         assert np.array_equal(c1, c4)
 
+
+
+@pytest.mark.slow
+def test_asan_flavor_parses_clean():
+    """Build the Makefile's `asan` flavor of the parse kernel and run a
+    mixed workload (all kinds, malformed rows, serial + multi-threaded)
+    under LD_PRELOADed libasan: any heap overflow / UB in fastparse.cpp
+    aborts the subprocess with a sanitizer report."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(native.__file__)
+    probe = subprocess.run(
+        ["gcc", "-print-file-name=libasan.so"], capture_output=True, text=True
+    )
+    libasan = probe.stdout.strip()
+    if probe.returncode != 0 or not os.path.isabs(libasan):
+        pytest.skip("toolchain has no libasan")
+    build = subprocess.run(
+        ["make", "-C", here, "asan"], capture_output=True, text=True
+    )
+    if build.returncode != 0:
+        pytest.skip(f"asan build unavailable: {build.stderr[-200:]}")
+
+    script = """
+import numpy as np
+from tpustream import native
+from tpustream.records import StringTable
+
+assert native.build_flavor() == "asan", native.build_flavor()
+assert native.available(), native.build_error()
+specs = [
+    (1, native.KIND_STR, 0),
+    (2, native.KIND_STR, 0),
+    (3, native.KIND_F64, 0),
+    (0, native.KIND_I64, 0),
+]
+p = native.NativeParser(" ", specs, [StringTable(), StringTable(), None, None])
+lines = [
+    f"15634520{i % 60:02d} 10.8.22.{i % 250} cpu{i % 16} {(i * 7) % 100}.5"
+    for i in range(50_000)
+]
+lines[777] = "garbage"
+lines[778] = "1 2"
+lines[779] = ""
+data = ("\\n".join(lines) + "\\n").encode()
+serial, bad1 = p.parse(data, len(lines), threads=1)
+p2 = native.NativeParser(" ", specs, [StringTable(), StringTable(), None, None])
+mt, bad4 = p2.parse(data, len(lines), threads=4)
+assert bad1 == bad4
+for a, b in zip(serial, mt):
+    assert np.array_equal(a, b)
+print("ASAN_PARSE_OK", len(serial[0]), bad1)
+"""
+    env = dict(os.environ)
+    env.update(
+        LD_PRELOAD=libasan,
+        ASAN_OPTIONS="detect_leaks=0,abort_on_error=1",
+        TPUSTREAM_NATIVE_FLAVOR="asan",
+        JAX_PLATFORMS="cpu",
+    )
+    run = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-2000:]
+    assert "ASAN_PARSE_OK" in run.stdout, out[-2000:]
+    assert "AddressSanitizer" not in out, out[-2000:]
+    assert "runtime error" not in out, out[-2000:]

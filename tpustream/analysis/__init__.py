@@ -1,12 +1,15 @@
 """Pre-flight static analysis of constructed job graphs.
 
-``analyze(env)`` runs every plan-lint rule (plan_rules) and the
-user-function purity analyzer (purity) over the env's sink graph and
-returns typed :class:`Finding` objects — all before any XLA trace.
+``analyze(env)`` runs every plan-lint rule (plan_rules), the
+user-function purity analyzer (purity), and whole-chain schema
+inference (schema) over the env's sink graph and returns typed
+:class:`Finding` objects — all before any XLA trace.
 ``StreamConfig.strict_analysis=True`` makes the executor call this at
 submission and raise :class:`PlanAnalysisError` on ERROR findings;
-``python -m tpustream.analysis.lint`` is the CLI form. The rule catalog
-lives in :data:`findings.CATALOG` and docs/analysis.md.
+``python -m tpustream.analysis.lint`` is the CLI form and
+``python -m tpustream.analysis.audit`` the checkpoint state-layout
+auditor (state_audit). The rule catalog lives in
+:data:`findings.CATALOG` and docs/analysis.md.
 """
 
 from __future__ import annotations
@@ -27,21 +30,35 @@ from .findings import (
 )
 from .plan_rules import AnalysisContext, run_plan_rules
 from .purity import analyze_callable, check_dtype_widening, run_purity_rules
+from .schema import (
+    FieldSchema,
+    RecordSchema,
+    SchemaReport,
+    StageSchema,
+    infer_schemas,
+    run_schema_rules,
+)
 
 __all__ = [
     "AnalysisContext",
     "CATALOG",
     "ERROR",
+    "FieldSchema",
     "Finding",
     "INFO",
     "PlanAnalysisError",
+    "RecordSchema",
     "Rule",
+    "SchemaReport",
+    "StageSchema",
     "WARN",
     "analyze",
     "analyze_callable",
     "check_dtype_widening",
     "has_errors",
+    "infer_schemas",
     "make_finding",
+    "run_schema_rules",
     "worst_severity",
 ]
 
@@ -60,6 +77,6 @@ def analyze(env, sink_nodes=None) -> List[Finding]:
     if not sink_nodes:
         return []
     ctx = AnalysisContext(env, sink_nodes)
-    findings = run_plan_rules(ctx) + run_purity_rules(ctx)
+    findings = run_plan_rules(ctx) + run_purity_rules(ctx) + run_schema_rules(ctx)
     findings.sort(key=lambda f: (-severity_rank(f.severity), f.code))
     return findings

@@ -260,6 +260,137 @@ CATALOG = {
             "cast back to the configured value_dtype inside the map, or "
             "widen value_dtype deliberately",
         ),
+        Rule(
+            "TSM025", INFO, "user-function source unavailable — purity checks skipped",
+            "inspect.getsource fails for REPL lambdas, C extensions and "
+            "exec'd callables, so the AST purity rules (TSM020–TSM024) "
+            "silently skip them; the function may hide nondeterminism or "
+            "side effects the analyzer cannot see.",
+            "define user functions in importable .py modules so their "
+            "source is lintable",
+        ),
+        Rule(
+            "TSM030", WARN, "keyed state routed by a float key column",
+            "key_by on an f64 column makes floating-point equality the "
+            "key identity; the packed-wire f32 demotion and the lane "
+            "transport both narrow f64 values when they round-trip, so "
+            "the 'same' key can hash to different state rows depending "
+            "on which side of the wire interned it, and NaN keys never "
+            "equal themselves.",
+            "key by a string or integer field; quantize float keys into "
+            "an int (e.g. int(v * 1000)) inside the parse map",
+        ),
+        Rule(
+            "TSM031", ERROR, "reduce/aggregate output schema drifts from its input",
+            "a window or rolling reduce must be (T, T) -> T: its output "
+            "feeds back as the next accumulator AND flows to the sink, "
+            "so an output whose arity or field kinds differ from the "
+            "input stream corrupts keyed state on the second fold (or "
+            "fails the trace mid-compile).",
+            "return a record with the same arity and field kinds as the "
+            "reduce inputs",
+        ),
+        Rule(
+            "TSM032", ERROR, "fleet job schema diverges from its TenantPlan template",
+            "every tenant job in a fleet shares ONE compiled program and "
+            "one keyed-state block; a job whose parse map infers a "
+            "different record schema (arity or field kinds) than the "
+            "template's would interleave mis-typed columns into shared "
+            "state rows.",
+            "build fleet jobs only through JobServer.build_job so every "
+            "tenant reuses the template's parse map",
+        ),
+        Rule(
+            "TSM033", INFO, "wide columns the wire demotion chains can never narrow",
+            "the packed-wire i64 chain (d16/d32 deltas) only exists when "
+            "h2d_compress is on — with h2d_compress=False every i64 "
+            "column ships raw int64 no matter what packed_wire says, so "
+            "the knob silently buys nothing for those columns (8 bytes/"
+            "row each, every batch).",
+            "re-enable h2d_compress alongside packed_wire, or accept "
+            "raw int64 transfers for the listed columns",
+        ),
+        Rule(
+            "TSM034", WARN, "producers of one side-output tag disagree on schema",
+            "two streams emitting under the same OutputTag id hand "
+            "get_side_output consumers records of different shapes — a "
+            "late-data tag carries the window's input records while a "
+            "CEP timeout tag carries (n_matched, start_ts, captures...), "
+            "so a consumer written for one schema misreads the other.",
+            "give each side output a distinct OutputTag id (TSM003) so "
+            "each consumer sees one schema",
+        ),
+        Rule(
+            "TSM040", ERROR, "checkpoint is missing expected state leaves",
+            "the snapshot holds fewer state arrays than the program "
+            "chain's init-state tree — an operator, rule leaf, or chain "
+            "stage was added since the snapshot; restore_state would "
+            "fail with a leaf-count mismatch mid-restore.",
+            "restart from the source (or an older build) instead of "
+            "resuming; the snapshot predates the current job graph",
+        ),
+        Rule(
+            "TSM041", ERROR, "checkpoint carries unexpected extra state leaves",
+            "the snapshot holds more state arrays than the program "
+            "chain expects — an operator, rule leaf, or chain stage was "
+            "removed since the snapshot; restore would fail rather than "
+            "silently drop the orphaned state.",
+            "restart from the source, or re-add the removed operator/"
+            "rules before resuming",
+        ),
+        Rule(
+            "TSM042", ERROR, "checkpoint leaf dtype differs from program state",
+            "a state leaf was saved with a different dtype than the "
+            "freshly built program allocates (value_dtype / acc_dtype / "
+            "ts_dtype changed); restore_state rejects the leaf rather "
+            "than silently reinterpreting its bytes.",
+            "restore under the config the snapshot was written with, or "
+            "restart from the source",
+        ),
+        Rule(
+            "TSM043", ERROR, "checkpoint leaf shape incompatible with program state",
+            "a state leaf's shape does not match the program's init "
+            "state and is not a growable key-sharded prefix — "
+            "batch_size, window, alert_capacity, or a shrunk "
+            "key_capacity changed since the snapshot.",
+            "restore under the snapshot's config; key_capacity may only "
+            "grow across a restore, never shrink",
+        ),
+        Rule(
+            "TSM044", ERROR, "tenant capacity mismatch between snapshot and fleet",
+            "the snapshot's tenancy block was written at a different "
+            "slot capacity than the fleet is configured for — per-tenant "
+            "[T] rule vectors and the tenant→slot map would mis-index "
+            "every tenant past the smaller capacity.",
+            "restore with tenant_capacity >= the snapshot's capacity "
+            "(fleet capacity only grows)",
+        ),
+        Rule(
+            "TSM045", ERROR, "checkpoint format version gap",
+            "the snapshot was written by a different tpustream format "
+            "version; the migration table (runtime/checkpoint.py) lists "
+            "what changed in between — restore would reject it outright, "
+            "and latest_checkpoint skips it.",
+            "restart from the source, or replay the snapshot under the "
+            "build that wrote it",
+        ),
+        Rule(
+            "TSM046", ERROR, "checkpoint unreadable or not a snapshot",
+            "the file is not a loadable .npz with tpustream metadata — "
+            "a partial write, a foreign file, or a truncated payload; "
+            "latest_checkpoint skips such files automatically.",
+            "delete the file (the next valid snapshot is used instead) "
+            "or restore a copy from backup",
+        ),
+        Rule(
+            "TSM047", INFO, "snapshot parallelism differs — restore will rescale",
+            "the snapshot was written at a different mesh parallelism; "
+            "restore permutes every key-sharded leaf through the "
+            "canonical key-major order onto the new layout (a supported, "
+            "lossless rescale — this finding just documents the work).",
+            "none required; pin parallelism across restarts to skip the "
+            "rescale permutation",
+        ),
     ]
 }
 

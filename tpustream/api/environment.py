@@ -127,6 +127,17 @@ class StreamExecutionEnvironment:
 
         return analyze(self, self._sinks)
 
+    def audit_checkpoint(self, path: str):
+        """Audit an on-disk checkpoint's state layout against THIS job
+        graph without loading its arrays or compiling anything: returns
+        an :class:`tpustream.analysis.state_audit.AuditReport` whose
+        verdict (compatible/incompatible/unknown) matches what an
+        actual restore would do, with TSM04x findings explaining any
+        drift. ``python -m tpustream.analysis.audit`` is the CLI form."""
+        from ..analysis.state_audit import audit_checkpoint
+
+        return audit_checkpoint(self, path, self._sinks)
+
     def execute(self, job_name: str = "tpustream job"):
         """Phase B: plan, compile, and run the job to source exhaustion.
 

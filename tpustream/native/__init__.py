@@ -22,7 +22,19 @@ KIND_I64 = 2
 KIND_ISO = 3
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_HERE, "_fastparse.so")
+# build flavors: "default" is the tuned production .so; "asan" (selected
+# with TPUSTREAM_NATIVE_FLAVOR=asan, plus LD_PRELOADing libasan into the
+# interpreter) is the Makefile's `asan` target with
+# -fsanitize=address,undefined for memory-safety runs of the same kernel
+_FLAVORS = {
+    "default": ("_fastparse.so", "_fastparse.so"),
+    "asan": ("_fastparse_asan.so", "asan"),
+}
+_flavor = os.environ.get("TPUSTREAM_NATIVE_FLAVOR", "default")
+if _flavor not in _FLAVORS:
+    _flavor = "default"
+_SO = os.path.join(_HERE, _FLAVORS[_flavor][0])
+_MAKE_TARGET = _FLAVORS[_flavor][1]
 _lock = threading.Lock()
 _lib = None
 _tried = False
@@ -44,9 +56,20 @@ def _build() -> bool:
     flight breadcrumb and the numpy path takes over."""
     global _build_error
     src = os.path.join(_HERE, "fastparse.cpp")
+    if _flavor == "asan":
+        fallback = [
+            "g++", "-O1", "-g", "-fno-omit-frame-pointer",
+            "-fsanitize=address,undefined", "-shared", "-fPIC",
+            "-std=c++17", "-pthread", src, "-o", _SO,
+        ]
+    else:
+        fallback = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            src, "-o", _SO,
+        ]
     attempts = [
-        ["make", "-C", _HERE, "_fastparse.so"],
-        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", _SO],
+        ["make", "-C", _HERE, _MAKE_TARGET],
+        fallback,
     ]
     errors = []
     for cmd in attempts:
@@ -133,6 +156,14 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def build_flavor() -> str:
+    """The build flavor this process selected ("default" or "asan", via
+    TPUSTREAM_NATIVE_FLAVOR) — named in the executor's
+    ``native_parse_ready`` flight breadcrumb so a postmortem (or a
+    sanitizer CI lane) shows which kernel actually ran."""
+    return _flavor
 
 
 class NativeTable:

@@ -517,6 +517,10 @@ def _selftest() -> int:
     # counters the executor mints when the analyzer reports
     g.group(code="TSM009").counter("analysis_findings_total").inc()
     g.group(code="TSM012").counter("analysis_findings_total").inc()
+    # schema-inference (TSM03x) and checkpoint-audit (TSM04x) codes land
+    # through the same per-code counter path
+    g.group(code="TSM030").counter("analysis_findings_total").inc()
+    g.group(code="TSM040").counter("analysis_findings_total").inc()
     # the satellite escaping case: backslash, quote, and newline in a
     # label value must survive the Prometheus text exposition
     reg.group(job="selftest", operator='he"llo\\wo\nrld').counter(
@@ -550,6 +554,14 @@ def _selftest() -> int:
     flight.record(
         "rule_applied", old_version=1, new_version=2,
         rules={"threshold": 95.0},
+    )
+    # the supervisor's pre-restore state-layout audit breadcrumb
+    # (runtime/supervisor.py _layout_audit; docs/recovery.md)
+    flight.record(
+        "checkpoint_audit",
+        path="ckpt-0000000001.npz",
+        verdict="compatible",
+        codes=[],
     )
     flight.record_exception(ValueError("boom"), operator="window")
     dump = flight.dump(meta={"job": "selftest"})
@@ -648,7 +660,11 @@ def _selftest() -> int:
         ("health render works",
          "lag_crit" in render_health(snap["health"])),
         ("flight ring bounded", len(dump["events"]) == 4),
-        ("flight counts drops", dump["dropped_events"] == 5),
+        ("flight counts drops", dump["dropped_events"] == 6),
+        ("flight keeps the checkpoint_audit breadcrumb",
+         any(e["kind"] == "checkpoint_audit"
+             and e.get("verdict") == "compatible"
+             for e in dump["events"])),
         ("flight keeps the exception",
          dump["events"][-1]["kind"] == "exception"
          and dump["events"][-1]["operator"] == "window"),
@@ -704,6 +720,10 @@ def _selftest() -> int:
         ("prometheus carries the per-code analysis findings",
          'analysis_findings_total{code="TSM009",job="selftest"} 1' in prom
          and 'analysis_findings_total{code="TSM012",job="selftest"} 1'
+         in prom),
+        ("prometheus carries the schema and audit finding codes",
+         'analysis_findings_total{code="TSM030",job="selftest"} 1' in prom
+         and 'analysis_findings_total{code="TSM040",job="selftest"} 1'
          in prom),
     ]
     checks.extend(_selftest_timeseries())

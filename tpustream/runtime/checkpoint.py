@@ -31,40 +31,51 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-# v2: window/process/session state gained device-side metric counter
-# leaves (window_fires / late_dropped), changing the snapshot treedef
-# v3: process state gained exchange_overflow (sharded process windows);
-# meta records parallelism because the sharded key layout is shard-major
-# v4: stateless state is a real alert_overflow counter (device-compacted
-# emissions); session process() programs add cell_min/max/pending_clear
-# v5: commutative rolling state derives occupancy from a -1-initialized
-# sentinel STR plane — a v4 snapshot's zero-initialized plane would read
-# every key row as already-seen
-# v6: session state gains cell_fired (allowed-lateness retention); count
-# windows gain element-log programs (ebuf/tot)
-# v7: meta carries lazy_schemas / key_capacities / chain_key_tables and
-# restore may rescale across parallelism or grow capacity (added late in
-# v6's life — the bump makes pre-feature builds reject such snapshots
-# with the version message instead of a leaf-shape ValueError);
-# DerivedKeyTable reserves id 0 as the filter-drop placeholder, shifting
-# every derived key id by one
-# v8: supervised recovery (runtime/supervisor.py) — meta gains a payload
-# checksum (load/validate detect corruption), absolute collect-sink
-# counts + quarantined dead-letter count at snapshot time (the restore
-# rollback that makes an in-process restart's output byte-identical to
-# an uninterrupted run), and the writing supervision session's nonce;
-# snapshots are now named by source position (monotone across restart
-# attempts, where the per-attempt batch counter is not)
-# v9: dynamic rules (tpustream/broadcast) — a broadcast-parameterized
-# job's state pytree carries rule leaves (__rules__/__rule_version__),
-# and meta records the host RuleSet's values plus its applied-update
-# count so a restore re-syncs the control-feed cursor exactly-once
-# v10: multi-tenancy (tpustream/tenancy) — rule leaves may be [T]
-# per-tenant vectors (rule_values carries the tenant table under
-# "__tenant__"), and meta gains a ``tenancy`` dict: the JobServer's
-# tenant→slot map, admitted/quota counters, and slot capacity, so a
-# supervised restart restores the whole fleet exactly-once
-FORMAT_VERSION = 10
+#: format migration table — what each version bump changed. Single
+#: source of truth: the state-layout auditor (analysis/state_audit.py)
+#: renders version-gap findings from these entries, and docs/recovery.md
+#: points here.
+MIGRATIONS = {
+    2: "window/process/session state gained device-side metric counter "
+       "leaves (window_fires / late_dropped), changing the snapshot treedef",
+    3: "process state gained exchange_overflow (sharded process windows); "
+       "meta records parallelism because the sharded key layout is "
+       "shard-major",
+    4: "stateless state is a real alert_overflow counter (device-compacted "
+       "emissions); session process() programs add cell_min/max/"
+       "pending_clear",
+    5: "commutative rolling state derives occupancy from a -1-initialized "
+       "sentinel STR plane — a v4 snapshot's zero-initialized plane would "
+       "read every key row as already-seen",
+    6: "session state gains cell_fired (allowed-lateness retention); count "
+       "windows gain element-log programs (ebuf/tot)",
+    7: "meta carries lazy_schemas / key_capacities / chain_key_tables and "
+       "restore may rescale across parallelism or grow capacity (added "
+       "late in v6's life — the bump makes pre-feature builds reject such "
+       "snapshots with the version message instead of a leaf-shape "
+       "ValueError); DerivedKeyTable reserves id 0 as the filter-drop "
+       "placeholder, shifting every derived key id by one",
+    8: "supervised recovery (runtime/supervisor.py) — meta gains a payload "
+       "checksum (load/validate detect corruption), absolute collect-sink "
+       "counts + quarantined dead-letter count at snapshot time (the "
+       "restore rollback that makes an in-process restart's output "
+       "byte-identical to an uninterrupted run), and the writing "
+       "supervision session's nonce; snapshots are now named by source "
+       "position (monotone across restart attempts, where the per-attempt "
+       "batch counter is not)",
+    9: "dynamic rules (tpustream/broadcast) — a broadcast-parameterized "
+       "job's state pytree carries rule leaves (__rules__/"
+       "__rule_version__), and meta records the host RuleSet's values "
+       "plus its applied-update count so a restore re-syncs the "
+       "control-feed cursor exactly-once",
+    10: "multi-tenancy (tpustream/tenancy) — rule leaves may be [T] "
+        "per-tenant vectors (rule_values carries the tenant table under "
+        "\"__tenant__\"), and meta gains a ``tenancy`` dict: the "
+        "JobServer's tenant→slot map, admitted/quota counters, and slot "
+        "capacity, so a supervised restart restores the whole fleet "
+        "exactly-once",
+}
+FORMAT_VERSION = max(MIGRATIONS)
 _META_KEY = "__meta__"
 
 
@@ -411,12 +422,19 @@ def validate_checkpoint(path: str) -> Optional[str]:
     return None
 
 
-def latest_checkpoint(directory: str, flight=None) -> Optional[str]:
+def latest_checkpoint(directory: str, flight=None, audit=None) -> Optional[str]:
     """Newest VALID snapshot in ``directory`` (the ``latest`` marker's
     target first, then the remaining snapshots newest-first). Partial,
     corrupt, or version-incompatible files are skipped — with a
     ``checkpoint_skipped`` flight breadcrumb when a recorder is passed —
-    instead of being handed to the supervisor as an unloadable path."""
+    instead of being handed to the supervisor as an unloadable path.
+
+    ``audit`` (optional): a ``path -> Optional[str]`` callable consulted
+    AFTER basic validation passes — the state-layout auditor
+    (analysis/state_audit.py) returns a reason string when the snapshot
+    cannot restore into the current job graph (leaf-tree drift the
+    version/checksum checks cannot see), pre-empting a mid-restore
+    failure; None lets the snapshot through."""
     if not os.path.isdir(directory):
         return None
     candidates: List[str] = []
@@ -440,6 +458,10 @@ def latest_checkpoint(directory: str, flight=None) -> Optional[str]:
         reason = (
             "missing" if not os.path.exists(p) else validate_checkpoint(p)
         )
+        if reason is None and audit is not None:
+            audit_reason = audit(p)
+            if audit_reason is not None:
+                reason = f"audit: {audit_reason}"
         if reason is None:
             return p
         if flight is not None:

@@ -2488,6 +2488,13 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 "native_parse_unavailable",
                 error=_native_mod.build_error() or "build not attempted",
             )
+        else:
+            # name the build flavor (default vs asan sanitizer kernel)
+            # so a postmortem shows which _fastparse variant ran
+            job_obs.flight.record(
+                "native_parse_ready",
+                flavor=_native_mod.build_flavor(),
+            )
     # pre-flight analysis findings (stashed by execute_job; popped so a
     # supervised restart doesn't double-count): WARN/ERROR go to the
     # flight ring, every finding increments the per-code counter

@@ -194,6 +194,36 @@ def _install_restart_health_rule(env) -> None:
     env.config = cfg.replace(obs=cfg.obs.replace(health_rules=rules + (rule,)))
 
 
+def _layout_audit(env, sink_nodes, flight):
+    """The ``latest_checkpoint(audit=...)`` hook: run the static
+    state-layout auditor (analysis/state_audit.py) over each candidate
+    snapshot BEFORE the supervisor commits to restoring it. A snapshot
+    whose leaf tree cannot restore into the current job graph is
+    skipped with the audit reason in the ``checkpoint_skipped``
+    breadcrumb — instead of failing mid-restore on the next attempt.
+    Every audit leaves a ``checkpoint_audit`` breadcrumb; auditor
+    crashes never block recovery (the restore path is authoritative)."""
+
+    def audit(path):
+        try:
+            from ..analysis.state_audit import audit_checkpoint
+
+            report = audit_checkpoint(env, path, sink_nodes)
+        except Exception:
+            return None
+        flight.record(
+            "checkpoint_audit",
+            path=path,
+            verdict=report.verdict,
+            codes=[f.code for f in report.findings],
+        )
+        if report.verdict == "incompatible":
+            return report.reason or "state layout incompatible"
+        return None
+
+    return audit
+
+
 def supervise(env, sink_nodes, run_attempt):
     """Run ``run_attempt(env, sink_nodes)`` under the configured restart
     strategy until it completes or the strategy gives up."""
@@ -268,7 +298,9 @@ def supervise(env, sink_nodes, run_attempt):
                     from .checkpoint import latest_checkpoint
 
                     ckpt = latest_checkpoint(
-                        env.config.checkpoint_dir, flight=flight
+                        env.config.checkpoint_dir,
+                        flight=flight,
+                        audit=_layout_audit(env, sink_nodes, flight),
                     )
                 if ckpt is None:
                     ckpt = user_restore
