@@ -431,6 +431,78 @@ def test_tsm016_clean_configurations():
     ]
 
 
+def test_tsm017_lane_restarts_over_nonreplayable_source():
+    from tpustream.runtime.sources import SocketTextSource
+
+    # raw-mode socket is splittable (lanes engage) but NOT replayable:
+    # the watchdog escalation rung has nothing to replay
+    env = make_env(ingest_lanes=2, ingest_lane_restarts=2)
+    (
+        env.add_source(SocketTextSource("localhost", 9999, raw=True))
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    f = next(f for f in env.analyze() if f.code == "TSM017")
+    assert f.severity == ERROR
+    assert "not replayable" in f.message
+
+
+def test_tsm017_lane_restarts_over_nonsplittable_source():
+    from tpustream.runtime.sources import SocketTextSource
+
+    env = make_env(ingest_lanes=2, ingest_lane_restarts=1)
+    (
+        env.add_source(SocketTextSource("localhost", 9999))
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    msgs = [f.message for f in env.analyze() if f.code == "TSM017"]
+    assert any("not line-splittable" in m for m in msgs)
+
+
+def test_tsm017_stall_limit_below_frame_deadline():
+    env = good_job(make_env(
+        ingest_lanes=2, max_batch_delay_ms=5.0,
+        ingest_lane_stall_limit_ms=8.0,
+    ))
+    f = next(f for f in env.analyze() if f.code == "TSM017")
+    assert f.severity == WARN
+    assert "recovered in a loop" in f.message
+
+
+def test_tsm017_clean_configurations():
+    # replayable in-memory source + default stall limit: no findings
+    env = good_job(make_env(ingest_lanes=2, ingest_lane_restarts=2))
+    assert "TSM017" not in codes(env.analyze())
+    # restarts=0 over a non-replayable source: the budget never spends,
+    # so the rule stays quiet (TSM016 still owns the splittability story)
+    from tpustream.runtime.sources import SocketTextSource
+
+    env = make_env(ingest_lanes=2, ingest_lane_restarts=0)
+    (
+        env.add_source(SocketTextSource("localhost", 9999, raw=True))
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(5))
+        .sum(2)
+        .print()
+    )
+    assert "TSM017" not in codes(env.analyze())
+    # stall detection disabled entirely: no WARN either
+    env = good_job(make_env(
+        ingest_lanes=2, ingest_lane_stall_limit_ms=0.0
+    ))
+    assert "TSM017" not in [
+        f.code for f in env.analyze() if f.severity == WARN
+    ]
+
+
 def test_findings_sorted_errors_first():
     # one ERROR (TSM013) + one INFO (TSM010) in a single graph
     env = make_env(async_depth=2)
@@ -633,7 +705,8 @@ def test_catalog_is_stable():
     expected = {
         "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
         "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
-        "TSM013", "TSM014", "TSM015", "TSM016", "TSM020", "TSM021",
+        "TSM013", "TSM014", "TSM015", "TSM016", "TSM017", "TSM020",
+        "TSM021",
         "TSM022", "TSM023", "TSM024", "TSM025", "TSM030", "TSM031",
         "TSM032", "TSM033", "TSM034", "TSM040", "TSM041", "TSM042",
         "TSM043", "TSM044", "TSM045", "TSM046", "TSM047",

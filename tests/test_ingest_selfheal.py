@@ -1,0 +1,391 @@
+"""Self-healing ingest plane (runtime/ingest.py, runtime/watchdog.py):
+lane supervision detects dead and hung workers, recovers their un-merged
+frames inline, respawns lanes within a bounded restart budget, folds
+repeat offenders out of the rotation, and escalates plane-wide stalls to
+the job supervisor through a typed watchdog error.
+
+The contract under test: every failure shape (SIGKILL, premature clean
+exit, heartbeat stall, watchdog escalation, restart-budget exhaustion)
+still yields byte-identical output and the same final-checkpoint digest
+as a single-lane run — the self-healing layer may only change *where*
+frames are parsed, never *what* the executor sees."""
+
+import hashlib
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.runtime.checkpoint import load_checkpoint
+from tpustream.runtime.ingest import LaneRestartPolicy
+from tpustream.runtime.sources import ReplaySource
+from tpustream.runtime.supervisor import (
+    LANE_RESTART_HEALTH_RULE_NAME,
+    fixed_delay,
+)
+from tpustream.runtime.watchdog import IngestStallError, StallWatchdog
+from tpustream.testing import FaultInjector, FaultPoint
+
+LINES = [
+    f"15634520{i:02d} 10.8.22.{i % 5} cpu{i % 3} {40 + (i * 31) % 55}.5"
+    for i in range(24)
+]
+
+# Long enough that the producer (bounded to 4 frames of look-ahead per
+# lane past the merge cursor) is still mid-stream when a lane death is
+# detected — a death discovered after EOS is parked as "done" rather
+# than respawned, which is correct but not what these tests exercise.
+LONG_LINES = [
+    f"15634520{i:02d} 10.8.22.{i % 5} cpu{i % 3} {40 + (i * 31) % 55}.5"
+    for i in range(72)
+]
+
+
+def run_job(lines, ckdir=None, strategy=None, injector=None, **over):
+    from tpustream.jobs.chapter2_max import build
+
+    over.setdefault("batch_size", 4)
+    over.setdefault("obs", ObsConfig(enabled=True))
+    cfg = StreamConfig(**over)
+    if ckdir is not None:
+        cfg = cfg.replace(
+            checkpoint_dir=str(ckdir), checkpoint_interval_batches=1
+        )
+    if injector is not None:
+        cfg = injector.install(cfg)
+    env = StreamExecutionEnvironment(cfg)
+    if strategy is not None:
+        env.set_restart_strategy(strategy)
+    handle = build(env, env.add_source(ReplaySource(lines))).collect()
+    result = env.execute("ingest-selfheal-test")
+    return env, handle.items, result
+
+
+def checkpoint_digest(path):
+    ck = load_checkpoint(str(path))
+    h = hashlib.sha256()
+    for leaf in ck.leaves:
+        a = np.asarray(leaf)
+        h.update(str((a.dtype.str, a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(
+        json.dumps(
+            [ck.source_pos, ck.emitted, ck.batches], sort_keys=True
+        ).encode()
+    )
+    return h.hexdigest()
+
+
+def replay_state_digest(path):
+    """Digest of just the replayable state: device leaves + source
+    cursor. Used across supervised restarts, where the `emitted` tally
+    is attempt-local by long-standing design and legitimately differs
+    from an uninterrupted run."""
+    ck = load_checkpoint(str(path))
+    h = hashlib.sha256()
+    for leaf in ck.leaves:
+        a = np.asarray(leaf)
+        h.update(str((a.dtype.str, a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(json.dumps(ck.source_pos, sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def flight_events(res):
+    return list(res.metrics.job_obs.flight.events())
+
+
+def flight_kinds(res):
+    return [e["kind"] for e in flight_events(res)]
+
+
+def series_by_name(res, name):
+    snap = res.metrics.obs_snapshot()
+    return [s for s in snap["metrics"]["series"] if s["name"] == name]
+
+
+# ---------------------------------------------------------------------------
+# watchdog + restart-policy unit behaviour
+# ---------------------------------------------------------------------------
+def test_stall_watchdog_fires_after_limit_and_disarm_cancels():
+    fired = []
+    wd = StallWatchdog(lambda name, limit: fired.append((name, limit)))
+    try:
+        wd.arm("a", 0.15)
+        deadline = time.monotonic() + 3.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired == [("a", 0.15)]
+        tok = wd.arm("b", 0.15)
+        wd.disarm(tok)
+        time.sleep(0.4)
+        assert fired == [("a", 0.15)]  # disarmed entry never fires
+    finally:
+        wd.close()
+
+
+def test_stall_watchdog_poke_defers_the_deadline():
+    fired = []
+    wd = StallWatchdog(lambda name, limit: fired.append(name))
+    try:
+        tok = wd.arm("work", 0.4)
+        # keep poking well past the original deadline: progress means
+        # no fire, exactly like a producer moving frames through a ring
+        for _ in range(5):
+            time.sleep(0.15)
+            wd.poke(tok)
+        assert fired == []
+        deadline = time.monotonic() + 3.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired == ["work"]
+    finally:
+        wd.close()
+
+
+def test_stall_watchdog_guard_suppresses_and_rearms():
+    fired = []
+    blocked_on_us = [False]
+    wd = StallWatchdog(lambda name, limit: fired.append(name))
+    try:
+        wd.arm("merge_wait", 0.15, guard=lambda: blocked_on_us[0])
+        time.sleep(0.5)
+        # guard said the wait was benign (source idle) — no escalation
+        assert fired == []
+        blocked_on_us[0] = True
+        deadline = time.monotonic() + 3.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired == ["merge_wait"]
+    finally:
+        wd.close()
+
+
+def test_stall_watchdog_zero_limit_is_disabled():
+    fired = []
+    wd = StallWatchdog(lambda name, limit: fired.append(name))
+    try:
+        tok = wd.arm("a", 0.0)
+        assert tok == -1
+        time.sleep(0.2)
+        assert fired == []
+    finally:
+        wd.close()
+
+
+def test_ingest_stall_error_carries_supervisor_cause():
+    err = IngestStallError("merge_wait", 30.0)
+    assert err.point == "ingest_stall"
+    assert err.scope == "merge_wait"
+    assert "merge_wait" in str(err)
+
+
+def test_lane_restart_policy_budget_is_per_lane():
+    pol = LaneRestartPolicy(2)
+    assert pol.may_restart(0)
+    assert pol.note_restart(0) == 1
+    assert pol.may_restart(0)
+    assert pol.note_restart(0) == 2
+    assert not pol.may_restart(0)  # lane 0 exhausted...
+    assert pol.may_restart(1)  # ...but lane 1 has its own budget
+    assert not LaneRestartPolicy(0).may_restart(0)
+
+
+# ---------------------------------------------------------------------------
+# failure shape 1: SIGKILL mid-stream -> in-place lane restart
+# ---------------------------------------------------------------------------
+def test_lane_crash_sigkill_inplace_recovery(tmp_path):
+    _, base_items, _ = run_job(LONG_LINES, ckdir=tmp_path / "base")
+    inj = FaultInjector(
+        FaultPoint("lane_worker_crash", at=3, exit_code=-9)
+    )
+    _, items, res = run_job(
+        LONG_LINES, ckdir=tmp_path / "healed", injector=inj, ingest_lanes=2
+    )
+
+    # byte-identical stream and checkpoint despite a dead worker
+    assert items == base_items
+    assert checkpoint_digest(tmp_path / "healed") == checkpoint_digest(
+        tmp_path / "base"
+    )
+
+    kinds = flight_kinds(res)
+    assert "ingest_lane_died" in kinds
+    assert "ingest_lane_restarted" in kinds
+    # the lane layer absorbed the fault: the job supervisor never saw it
+    assert "job_failed" not in kinds
+    assert "job_restarting" not in kinds
+    died = [e for e in flight_events(res) if e["kind"] == "ingest_lane_died"]
+    assert died[0]["shape"] == "exit"
+
+    restarts = series_by_name(res, "ingest_lane_restarts_total")
+    assert sum(s["value"] for s in restarts) >= 1
+    assert all("lane" in s["labels"] for s in restarts)
+    assert series_by_name(res, "job_restarts_total") == []
+
+
+def test_lane_crash_trips_builtin_health_rule(tmp_path):
+    inj = FaultInjector(
+        FaultPoint("lane_worker_crash", at=2, exit_code=-9)
+    )
+    _, _, res = run_job(LONG_LINES, injector=inj, ingest_lanes=2)
+    health = res.metrics.obs_snapshot()["health"]
+    rules = [
+        r
+        for r in health["rules"]
+        if r["rule"] == LANE_RESTART_HEALTH_RULE_NAME
+    ]
+    assert rules and rules[0]["level"] == "warn"
+
+
+# ---------------------------------------------------------------------------
+# failure shape 2: premature clean exit (the exit-0 regression)
+# ---------------------------------------------------------------------------
+def test_premature_clean_exit_is_detected_not_hung(tmp_path):
+    """A worker that exits 0 before acknowledging EOS used to leave the
+    merge waiting forever; supervision must treat it as a death."""
+    _, base_items, _ = run_job(LONG_LINES)
+    inj = FaultInjector(
+        FaultPoint("lane_worker_crash", at=2, exit_code=0)
+    )
+    _, items, res = run_job(LONG_LINES, injector=inj, ingest_lanes=2)
+    assert items == base_items
+    died = [e for e in flight_events(res) if e["kind"] == "ingest_lane_died"]
+    assert died and died[0]["shape"] == "premature_exit"
+    assert "ingest_lane_restarted" in flight_kinds(res)
+    assert "job_failed" not in flight_kinds(res)
+
+
+# ---------------------------------------------------------------------------
+# failure shape 3: hang -> heartbeat stall -> in-place lane restart
+# ---------------------------------------------------------------------------
+def test_lane_hang_heartbeat_stall_inplace_recovery(tmp_path):
+    _, base_items, _ = run_job(LONG_LINES, ckdir=tmp_path / "base")
+    inj = FaultInjector(FaultPoint("lane_worker_hang", at=2))
+    _, items, res = run_job(
+        LONG_LINES,
+        ckdir=tmp_path / "healed",
+        injector=inj,
+        ingest_lanes=2,
+        ingest_lane_stall_limit_ms=300.0,
+    )
+    assert items == base_items
+    assert checkpoint_digest(tmp_path / "healed") == checkpoint_digest(
+        tmp_path / "base"
+    )
+    died = [e for e in flight_events(res) if e["kind"] == "ingest_lane_died"]
+    assert died and died[0]["shape"] == "stall"
+    assert died[0]["heartbeat_age_ms"] >= 300.0
+    assert "ingest_lane_restarted" in flight_kinds(res)
+    assert "job_failed" not in flight_kinds(res)
+
+
+# ---------------------------------------------------------------------------
+# escalation: stall detection off -> watchdog -> supervised restart
+# ---------------------------------------------------------------------------
+def test_hang_escalates_to_watchdog_and_supervised_restart(tmp_path):
+    _, base_items, _ = run_job(LONG_LINES, ckdir=tmp_path / "base")
+    inj = FaultInjector(FaultPoint("lane_worker_hang", at=2))
+    _, items, res = run_job(
+        LONG_LINES,
+        ckdir=tmp_path / "healed",
+        strategy=fixed_delay(3, 0.0),
+        injector=inj,
+        ingest_lanes=2,
+        ingest_lane_stall_limit_ms=0.0,  # lane-level healing off
+        extra={"ingest_watchdog_limit_ms": 700.0},
+    )
+    # exactly-once across the supervised restart
+    assert items == base_items
+    assert replay_state_digest(tmp_path / "healed") == replay_state_digest(
+        tmp_path / "base"
+    )
+    kinds = flight_kinds(res)
+    assert "watchdog_fired" in kinds
+    assert "job_failed" in kinds
+    assert "job_recovered" in kinds
+    restarting = [
+        e for e in flight_events(res) if e["kind"] == "job_restarting"
+    ]
+    assert restarting and restarting[0]["cause"] == "ingest_stall"
+
+
+# ---------------------------------------------------------------------------
+# the degradation ladder: budget exhausted -> fold out -> inline
+# ---------------------------------------------------------------------------
+def test_fold_out_ladder_degrades_to_inline(tmp_path):
+    _, base_items, _ = run_job(LONG_LINES, ckdir=tmp_path / "base")
+    inj = FaultInjector(
+        FaultPoint("lane_worker_crash", at=0, exit_code=-9),
+        FaultPoint("lane_worker_crash", at=1, exit_code=-9),
+    )
+    _, items, res = run_job(
+        LONG_LINES,
+        ckdir=tmp_path / "degraded",
+        injector=inj,
+        ingest_lanes=2,
+        ingest_lane_restarts=0,  # no budget: first death folds the lane
+    )
+    assert items == base_items
+    assert checkpoint_digest(tmp_path / "degraded") == checkpoint_digest(
+        tmp_path / "base"
+    )
+    kinds = flight_kinds(res)
+    assert kinds.count("ingest_lane_folded") == 2
+    assert "ingest_degraded" in kinds
+    assert "ingest_lane_restarted" not in kinds
+    assert "job_failed" not in kinds
+    folded = series_by_name(res, "ingest_lane_folded")
+    assert sorted(s["labels"]["lane"] for s in folded if s["value"] == 1.0) == [
+        "0",
+        "1",
+    ]
+
+
+def test_single_lane_death_folds_and_survivor_carries_stream(tmp_path):
+    """One lane exhausts its budget and folds; the rotation continues on
+    the survivor without degrading the whole plane."""
+    _, base_items, _ = run_job(LONG_LINES)
+    inj = FaultInjector(
+        FaultPoint("lane_worker_crash", at=1, exit_code=-9)
+    )
+    _, items, res = run_job(
+        LONG_LINES, injector=inj, ingest_lanes=2, ingest_lane_restarts=0
+    )
+    assert items == base_items
+    kinds = flight_kinds(res)
+    assert kinds.count("ingest_lane_folded") == 1
+    assert "ingest_degraded" not in kinds  # a live lane remains
+    folded = series_by_name(res, "ingest_lane_folded")
+    live = [s for s in folded if s["value"] == 0.0]
+    assert live  # the survivor's gauge stays down
+
+
+# ---------------------------------------------------------------------------
+# slow tier: multi-fault soak — lane crash + device fault + restart
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_multi_fault_soak_lane_crash_plus_device_step(tmp_path):
+    _, base_items, _ = run_job(LONG_LINES, ckdir=tmp_path / "base")
+    inj = FaultInjector(
+        FaultPoint("lane_worker_crash", at=1, exit_code=-9),
+        FaultPoint("device_step", at=3),
+    )
+    _, items, res = run_job(
+        LONG_LINES,
+        ckdir=tmp_path / "soak",
+        strategy=fixed_delay(3, 0.0),
+        injector=inj,
+        ingest_lanes=2,
+    )
+    assert items == base_items
+    assert replay_state_digest(tmp_path / "soak") == replay_state_digest(
+        tmp_path / "base"
+    )
+    kinds = flight_kinds(res)
+    # both recovery layers engaged on the same run
+    assert "ingest_lane_died" in kinds
+    assert "job_recovered" in kinds

@@ -259,7 +259,7 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 73
+    assert int(m.group(1)) == 77
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     # ... and the sharded-ingestion lane series
@@ -273,6 +273,9 @@ def test_dump_selftest_smoke(capsys):
     assert "ok: prometheus carries the per-code analysis findings" in out
     # ... including the schema-inference / checkpoint-audit codes
     assert "ok: prometheus carries the schema and audit finding codes" in out
+    # the lane supervision / self-healing surface is part of the suite
+    assert "ok: prometheus carries the lane supervision series" in out
+    assert "ok: flight keeps the degradation ladder in order" in out
     assert "ok: flight keeps the checkpoint_audit breadcrumb" in out
 
 

@@ -219,6 +219,20 @@ CATALOG = {
             "knob back to 1",
         ),
         Rule(
+            "TSM017", ERROR, "lane supervision misconfigured for this job",
+            "the self-healing ingest plane (ingest_lane_restarts, "
+            "ingest_lane_stall_limit_ms) recovers dead lane workers in "
+            "place, but its escalation ladder ends at the supervisor: a "
+            "wedged plane raises IngestStallError, and restarting from "
+            "that needs a splittable, replayable source — otherwise the "
+            "lanes never engage or the escalation kills the job with "
+            "nothing to replay. A stall limit below ~2x the frame "
+            "deadline recovers healthy-but-slow lanes in a loop.",
+            "use a splittable, replayable source with lane restarts, "
+            "or raise ingest_lane_stall_limit_ms comfortably above "
+            "2x max_batch_delay_ms (0 disables heartbeat detection)",
+        ),
+        Rule(
             "TSM020", WARN, "nondeterministic call in a user function",
             "time/random/datetime/uuid calls make replay diverge: a "
             "supervised restart reprocesses records from the last "

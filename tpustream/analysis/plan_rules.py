@@ -278,6 +278,57 @@ def check_ingest_lane_misconfig(ctx) -> Iterable[Finding]:
 
 
 @rule
+def check_lane_supervision_misconfig(ctx) -> Iterable[Finding]:
+    """TSM017: lane-supervision knobs that cannot deliver what they
+    promise.
+
+    In-place lane recovery itself needs no source cooperation (the
+    producer retains raw frames until merged), but the ladder's last
+    rung — StallWatchdog escalation to a supervised restart-with-cause
+    (IngestStallError) — replays from a checkpoint, and a
+    non-splittable source never engages the lanes at all (TSM016). So a
+    restart budget over a non-splittable or non-replayable source is
+    either dead config or a configured path to an unrecoverable
+    failure. Separately, a heartbeat stall limit below ~2x the typical
+    frame deadline (max_batch_delay_ms) reads healthy-but-slow lanes
+    as hung and recovers them in a loop."""
+    cfg = ctx.cfg
+    lanes = getattr(cfg, "ingest_lanes", 1)
+    if lanes <= 1:
+        return
+    restarts = getattr(cfg, "ingest_lane_restarts", 0)
+    if restarts > 0:
+        for node in ctx.nodes("source"):
+            src = node.params.get("source")
+            if src is None:
+                continue
+            splittable = getattr(src, "splittable", True)
+            replayable = getattr(src, "replayable", True)
+            if not splittable or not replayable:
+                why = (
+                    "is not line-splittable (the lanes never engage)"
+                    if not splittable else
+                    "is not replayable (a watchdog escalation has "
+                    "nothing to replay)"
+                )
+                yield make_finding(
+                    "TSM017", node,
+                    f"ingest_lane_restarts={restarts} but source "
+                    f"{type(src).__name__} {why}",
+                )
+    stall_ms = float(getattr(cfg, "ingest_lane_stall_limit_ms", 0.0))
+    floor_ms = 2.0 * float(getattr(cfg, "max_batch_delay_ms", 0.0))
+    if 0.0 < stall_ms < floor_ms:
+        yield make_finding(
+            "TSM017", None,
+            f"ingest_lane_stall_limit_ms={stall_ms:g} is below 2x the "
+            f"frame deadline (max_batch_delay_ms={floor_ms / 2.0:g}): "
+            "healthy-but-slow lanes will be recovered in a loop",
+            severity=WARN,
+        )
+
+
+@rule
 def check_compaction_on_mesh(ctx) -> Iterable[Finding]:
     """TSM006: compaction_capacity on p>1 is silently ignored."""
     cfg = ctx.cfg

@@ -279,6 +279,34 @@ class StreamConfig:
     # time). Lanes beyond the host's core count add scheduling overhead
     # without parse throughput (TSM016 WARN).
 
+    ingest_lane_restarts: int = 2
+    # Lane supervision budget (runtime/ingest.py): how many times a dead
+    # ingest lane worker (nonzero exit, premature clean exit before EOS,
+    # or heartbeat stall) is respawned IN PLACE, per lane, before the
+    # lane folds out of the round-robin permanently. Recovery is local:
+    # the producer retains every raw frame until its seq is merged, so a
+    # dead lane's un-merged frames re-parse via the inline host route at
+    # their exact sequence positions — output stays byte-identical and
+    # the job never restarts (job_restarts_total stays 0; the lane-level
+    # ingest_lane_restarts_total{lane=...} counter ticks instead). 0 =
+    # fold immediately on first death. All lanes folded degrades the
+    # whole plane to the inline path (ingest_degraded breadcrumb): the
+    # job keeps running slower instead of dying.
+
+    ingest_lane_stall_limit_ms: float = 5000.0
+    # Heartbeat stall detection for lane workers: each worker stamps a
+    # shared monotonic timestamp per frame (and while idle); a lane with
+    # work outstanding whose heartbeat is older than this limit is
+    # declared hung and recovered exactly like a crashed one (SIGTERM,
+    # frames re-routed inline, bounded respawn per ingest_lane_restarts).
+    # 0 disables heartbeat detection — a hung worker then surfaces via
+    # the plane-level StallWatchdog as a typed IngestStallError the
+    # supervisor restarts-with-cause (extra["ingest_watchdog_limit_ms"]
+    # tunes that escalation deadline; default max(30s, 4x this limit)).
+    # Set comfortably above the slowest legitimate frame parse: a limit
+    # below ~2x the typical frame deadline recovers healthy-but-slow
+    # lanes in a loop (analyzer rule TSM017 WARNs).
+
     parse_ahead: int = 0
     # Source+parse pipelining depth: >0 moves the host stage (source
     # read, line skip on resume, parse + intern) onto its own thread
@@ -401,4 +429,22 @@ class StreamConfig:
                           "single-lane host stage",
             })
             cfg = cfg.replace(ingest_lanes=1)
+        if self.ingest_lane_restarts < 0:
+            notes.append({
+                "knob": "ingest_lane_restarts",
+                "requested": self.ingest_lane_restarts,
+                "effective": 0,
+                "reason": "ingest_lane_restarts must be >= 0; 0 folds a "
+                          "lane out on its first death",
+            })
+            cfg = cfg.replace(ingest_lane_restarts=0)
+        if self.ingest_lane_stall_limit_ms < 0:
+            notes.append({
+                "knob": "ingest_lane_stall_limit_ms",
+                "requested": self.ingest_lane_stall_limit_ms,
+                "effective": 0.0,
+                "reason": "ingest_lane_stall_limit_ms must be >= 0; 0 "
+                          "disables heartbeat stall detection",
+            })
+            cfg = cfg.replace(ingest_lane_stall_limit_ms=0.0)
         return cfg, notes

@@ -34,6 +34,9 @@ KNOWN_SERIES = frozenset({
     # sharded ingestion (runtime/ingest.py), lane-labelled
     "ingest_lane_records_total", "ingest_ring_occupancy",
     "ingest_lane_stall_ms",
+    # lane supervision / self-healing (runtime/ingest.py), lane-labelled
+    "ingest_lane_restarts_total", "ingest_lane_folded",
+    "ingest_heartbeat_age_ms",
     # compile registry
     "compile_count", "recompile_count", "compile_wall_ms",
     "compile_flops", "compile_bytes_accessed", "compile_instrument_fallback",

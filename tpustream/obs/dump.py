@@ -494,6 +494,12 @@ def _selftest() -> int:
     lg.gauge("ingest_ring_occupancy").set(0.25)
     g.group(lane="1").counter("ingest_lane_records_total").inc(240)
     g.histogram("ingest_lane_stall_ms").observe(1.25)
+    # lane supervision series (runtime/ingest.py self-healing,
+    # docs/recovery.md): per-lane restart counters, the fold-out gauge,
+    # and the pull-evaluated heartbeat age gauge
+    lg.counter("ingest_lane_restarts_total").inc(1)
+    lg.gauge("ingest_heartbeat_age_ms").set_fn(lambda: 12.5)
+    g.group(lane="1").gauge("ingest_lane_folded").set(1)
     # multi-tenant fleet series (docs/multitenancy.md): the fleet-size
     # gauge plus per-tenant-labeled admission/quota/rule-version series
     # the JobServer mints through the same group path
@@ -565,6 +571,25 @@ def _selftest() -> int:
     )
     flight.record_exception(ValueError("boom"), operator="window")
     dump = flight.dump(meta={"job": "selftest"})
+    # lane supervision breadcrumbs (runtime/ingest.py, docs/recovery.md):
+    # the full degradation ladder — died -> restarted -> folded ->
+    # degraded — plus both watchdog events, in a ring of their own so
+    # the bounded-ring checks above keep their pinned counts
+    sflight = FlightRecorder(capacity=8)
+    sflight.record(
+        "watchdog_armed", scopes=["merge_wait", "producer_ring"],
+        limit_ms=30000.0, stall_limit_ms=5000.0, lane_restart_budget=2,
+    )
+    sflight.record(
+        "ingest_lane_died", lane=0, gen=0, shape="exit", exitcode=-9,
+        rerouted_frames=2,
+    )
+    sflight.record("ingest_lane_restarted", lane=0, gen=1, restarts=1,
+                   budget=2)
+    sflight.record("ingest_lane_folded", lane=1, restarts=2, budget=2)
+    sflight.record("ingest_degraded", lanes=2)
+    sflight.record("watchdog_fired", scope="merge_wait", limit_ms=30000.0)
+    sdump = sflight.dump(meta={"job": "selftest"})
 
     text = render(snap)
     prom = snap["prometheus"]
@@ -725,6 +750,23 @@ def _selftest() -> int:
          'analysis_findings_total{code="TSM030",job="selftest"} 1' in prom
          and 'analysis_findings_total{code="TSM040",job="selftest"} 1'
          in prom),
+        ("prometheus carries the lane supervision series",
+         'ingest_lane_restarts_total{job="selftest",lane="0"} 1' in prom
+         and 'ingest_lane_folded{job="selftest",lane="1"} 1' in prom),
+        ("set_fn heartbeat age gauge evaluates in the exposition",
+         'ingest_heartbeat_age_ms{job="selftest",lane="0"} 12.5' in prom),
+        ("flight keeps the watchdog breadcrumbs",
+         any(e["kind"] == "watchdog_armed"
+             and e.get("scopes") == ["merge_wait", "producer_ring"]
+             for e in sdump["events"])
+         and any(e["kind"] == "watchdog_fired"
+                 and e.get("scope") == "merge_wait"
+                 for e in sdump["events"])),
+        ("flight keeps the degradation ladder in order",
+         [e["kind"] for e in sdump["events"]
+          if e["kind"].startswith("ingest_")]
+         == ["ingest_lane_died", "ingest_lane_restarted",
+             "ingest_lane_folded", "ingest_degraded"]),
     ]
     checks.extend(_selftest_timeseries())
     checks.extend(_selftest_profile())

@@ -261,14 +261,51 @@ class LaneSpec:
         return ev, tables
 
 
-def _drain_credit(q, stop_ev, timeout: float = 0.2):
-    """Block for one ring credit, aborting when the plane shuts down."""
+def _drain_credit(q, stop_ev, timeout: float = 0.2, heartbeat=None):
+    """Block for one ring credit, aborting when the plane shuts down.
+
+    The credit wait stamps the worker heartbeat per tick: a worker
+    backpressured on a full output ring is healthy, and the lane
+    supervisor must not read its silence as a stall."""
     while True:
         try:
             return q.get(timeout=timeout)
         except _queue.Empty:
             if stop_ev.is_set():
                 raise _LaneStop()
+            _stamp(heartbeat)
+
+
+def _stamp(heartbeat) -> None:
+    """Stamp this worker's shared heartbeat (monotonic is system-wide on
+    the platforms the plane runs on, so parent-side age math is valid)."""
+    if heartbeat is not None:
+        heartbeat.value = time.monotonic()
+
+
+def _check_lane_faults(faults, seq: int) -> None:
+    """Evaluate testing/faults.py lane fault specs inside the worker.
+
+    Each spec is ``(point, at, times, exit_code, fires)`` with ``fires``
+    a shared-memory counter living on the injector's FaultPoint — spent
+    budgets survive worker respawns AND supervised job restarts, so a
+    fault fires exactly ``times`` times per test no matter how many
+    processes replay frame ``at``.
+    """
+    for point, at, times, exit_code, fires in faults:
+        if not (at <= seq < at + max(1, times)):
+            continue
+        with fires.get_lock():
+            if fires.value >= max(1, times):
+                continue
+            fires.value += 1
+        if point == "lane_worker_crash":
+            if exit_code < 0:
+                os.kill(os.getpid(), -exit_code)
+                time.sleep(60)  # pending-signal window; never returns
+            os._exit(exit_code)
+        else:  # lane_worker_hang: stop dead, no heartbeat, until killed
+            time.sleep(3600)
 
 
 class _LaneStop(Exception):
@@ -287,6 +324,8 @@ def lane_worker_main(
     ack_in_q,
     ack_out_q,
     stop_ev,
+    heartbeat=None,
+    faults=(),
 ) -> None:
     """One lane worker: input ring frames -> parse plan -> packed output
     ring frames, sequence numbers passed through untouched.
@@ -300,6 +339,15 @@ def lane_worker_main(
       ``("host", seq)`` — this frame defeats the native plan (blank
       lines, oversized, no native parser): the producer-retained source
       batch takes the ordinary inline parse path at the merge point.
+
+    ``heartbeat`` (a shared double) is stamped per frame AND per idle /
+    credit-wait tick, so the lane supervisor (runtime/ingest.py) reads
+    a fresh timestamp from any healthy worker — idle, parsing, or
+    backpressured — and a stale one only from a genuinely hung process.
+    The worker may exit 0 only after an ``("eos",)`` message (or
+    ``("stop",)`` at shutdown); the supervisor treats any earlier clean
+    exit as lane death. ``faults`` carries testing/faults.py lane fault
+    specs, checked at each frame's sequence number before parsing.
     """
     in_ring = out_ring = None
     try:
@@ -308,11 +356,21 @@ def lane_worker_main(
         ev, tables = spec.build_evaluator()
         shipped = [0] * len(tables)
         sticky = [0] * len(spec.kinds)
+        _stamp(heartbeat)
         while True:
-            msg = in_q.get()
-            if msg[0] == "stop":
+            try:
+                msg = in_q.get(timeout=0.5)
+            except _queue.Empty:
+                if stop_ev.is_set():
+                    break
+                _stamp(heartbeat)
+                continue
+            if msg[0] in ("stop", "eos"):
                 break
             _, seq, off, cost, nbytes, n_lines = msg
+            if faults:
+                _check_lane_faults(faults, seq)
+            _stamp(heartbeat)
             t0 = time.perf_counter()
             data = in_ring.read(off, nbytes)
             cols = ev.parse_bytes(data, n_lines) if ev is not None else None
@@ -337,12 +395,14 @@ def lane_worker_main(
                     shipped[j] = len(t._to_str)
             dur = time.perf_counter() - t0
             off2, cost2 = out_ring.write(
-                payload, lambda: _drain_credit(ack_out_q, stop_ev)
+                payload,
+                lambda: _drain_credit(ack_out_q, stop_ev, heartbeat=heartbeat),
             )
             out_q.put(
                 ("frame", seq, off2, cost2, len(payload), n_lines,
                  metas, new_strings, dur)
             )
+            _stamp(heartbeat)
     except _LaneStop:
         pass
     except Exception as e:  # pragma: no cover - surfaced via merge

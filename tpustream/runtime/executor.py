@@ -2393,6 +2393,13 @@ def execute_job(env, sink_nodes) -> JobResult:
             env._analysis_findings = findings
         if getattr(env.config, "strict_analysis", False) and has_errors(findings):
             raise PlanAnalysisError(findings)
+    # self-healing ingest plane (runtime/ingest.py): lane recovery keeps
+    # the job running with no job restart, so surface it through the
+    # same built-in WARN health-rule mechanism as job_restarted
+    if env.config.ingest_lanes > 1 and env.config.obs.enabled:
+        from .supervisor import _install_lane_restart_health_rule
+
+        _install_lane_restart_health_rule(env)
     if getattr(env.config, "restart_strategy", None) is not None:
         from .supervisor import supervise
 

@@ -1,4 +1,4 @@
-"""Sharded host ingestion: the IngestPlane.
+"""Sharded host ingestion: the IngestPlane, now self-healing.
 
 The single-lane host stage is one socket -> one parse thread -> one H2D
 lane; its measured single-stream wire ceiling (~531K rows/s, BENCH_r05)
@@ -13,7 +13,7 @@ columns back; the merge point below interleaves them deterministically.
 Determinism contract — the whole design hangs off it:
 
 * the producer assigns a SEQUENCE NUMBER to every source batch and
-  frames them round-robin (``seq % lanes``);
+  frames them round-robin over the LIVE lanes;
 * the merge consumes strictly in sequence order, so sink output is
   byte-identical to the single-lane path regardless of worker timing;
 * per-lane interned-string ids are remapped onto the job's plan tables
@@ -32,6 +32,35 @@ batches, blank lines defeating the native parser, oversized frames)
 fall back to the executor's ordinary inline ``_prepare`` path AT THEIR
 SEQUENCE POSITION, so the interleave — and therefore the output — stays
 exact.
+
+Lane supervision (the self-healing layer). Flink restarts failed TASKS,
+not jobs; before this layer, one OOM-killed lane worker burned a full
+supervised restart + checkpoint replay, and a hung worker (alive but
+stuck) or one that exited 0 before EOS was never detected at all — the
+merge spun on its wait forever. Supervision rests on the same retention
+rule that makes fallback frames exact: the producer keeps every raw
+SourceBatch in ``_meta`` until its seq is merged, so a dead lane's
+un-merged frames simply re-route to the inline host path at their exact
+sequence positions — byte-identical output, exactly-once untouched, no
+FORMAT_VERSION change. The pieces:
+
+* each worker stamps a shared monotonic HEARTBEAT per frame and per
+  idle/backpressure tick (parallel/lanes.py);
+* ``_scan_lanes`` (called on every merge wait tick) detects all three
+  death shapes: nonzero exit, PREMATURE clean exit (exit 0 before the
+  producer sent that lane ``eos``), and a heartbeat stall past
+  ``StreamConfig.ingest_lane_stall_limit_ms`` with work outstanding;
+* recovery re-routes the lane's retained frames inline, then a bounded
+  :class:`LaneRestartPolicy` (``StreamConfig.ingest_lane_restarts`` per
+  lane) respawns the worker with fresh ShmRings and re-enters it into
+  the round-robin — or, budget exhausted, FOLDS the lane out for good
+  (the round-robin redistributes over survivors). All lanes folded
+  degrades the plane to the inline path with an ``ingest_degraded``
+  breadcrumb: the job keeps running slower instead of dying;
+* a :class:`~tpustream.runtime.watchdog.StallWatchdog` arms around the
+  producer's ring-credit waits and the merge waits, so a WEDGED plane
+  (not just a dead worker) escalates as a typed ``IngestStallError``
+  the supervisor restarts-with-cause instead of hanging forever.
 """
 
 from __future__ import annotations
@@ -45,6 +74,7 @@ import numpy as np
 from ..parallel.lanes import LaneSpec, ShmRing, spawn_lane, unpack_columns
 from ..records import STR, Batch, Column
 from .metrics import Stopwatch
+from .watchdog import IngestStallError, StallWatchdog
 
 #: default per-direction shared-memory ring bytes per lane
 #: (override via StreamConfig.extra["ingest_ring_bytes"])
@@ -53,6 +83,10 @@ DEFAULT_RING_BYTES = 8 << 20
 #: producer look-ahead bound, in frames past the merge cursor — keeps an
 #: eager source from buffering the whole stream in host-frame metadata
 _MAX_AHEAD_FRAMES = 4
+
+#: fault points forwarded into lane workers (mirrors
+#: testing/faults.py LANE_FAULT_POINTS without importing the test module)
+_LANE_FAULT_POINTS = ("lane_worker_crash", "lane_worker_hang")
 
 
 class _Remap:
@@ -82,6 +116,84 @@ class _Remap:
 
     def view(self) -> np.ndarray:
         return self._buf[: self._n]
+
+
+class LaneRestartPolicy:
+    """Bounded per-lane respawn budget: ``budget`` restarts per lane,
+    then the lane folds out permanently. A separate object (not a bare
+    counter on the lane) so the ladder is testable in isolation and the
+    budget survives the lane's incarnation churn."""
+
+    def __init__(self, budget: int):
+        self.budget = max(0, int(budget))
+        self.used: dict = {}
+
+    def may_restart(self, lane_idx: int) -> bool:
+        return self.used.get(lane_idx, 0) < self.budget
+
+    def note_restart(self, lane_idx: int) -> int:
+        n = self.used.get(lane_idx, 0) + 1
+        self.used[lane_idx] = n
+        return n
+
+
+class _Incarnation:
+    """One spawned lane worker and everything that dies with it: both
+    ShmRings, all four queues, the shared heartbeat, and its private
+    stop event. A respawned lane gets a FRESH incarnation — fresh rings
+    (the old ones may hold frames the dead worker half-consumed), fresh
+    queues (the old ones may hold a dead worker's stale descriptors),
+    fresh lane-local intern state on the worker side."""
+
+    __slots__ = (
+        "gen", "proc", "in_ring", "out_ring", "in_q", "out_q",
+        "ack_in", "ack_out", "heartbeat", "stop_ev",
+    )
+
+    def __init__(self, ctx, lane_idx: int, gen: int, spec, ring_bytes,
+                 lane_faults):
+        self.gen = gen
+        self.in_ring = ShmRing(ring_bytes)
+        self.out_ring = ShmRing(ring_bytes)
+        self.in_q, self.out_q = ctx.Queue(), ctx.Queue()
+        self.ack_in, self.ack_out = ctx.Queue(), ctx.Queue()
+        self.heartbeat = ctx.Value("d", time.monotonic())
+        self.stop_ev = ctx.Event()
+        self.proc = spawn_lane(
+            ctx, lane_idx, spec,
+            (self.in_ring.name, ring_bytes, self.out_ring.name, ring_bytes,
+             self.in_q, self.out_q, self.ack_in, self.ack_out,
+             self.stop_ev, self.heartbeat, lane_faults),
+        )
+
+    def heartbeat_age_s(self) -> float:
+        return time.monotonic() - self.heartbeat.value
+
+
+class _Lane:
+    """One supervised round-robin slot: the current incarnation plus the
+    state the producer and merge share under the plane's condition
+    variable. ``state``: "up" (dispatchable), "folded" (restart budget
+    spent — permanently out), "done" (died after EOS; nothing left to
+    assign, so no respawn). ``inflight`` holds the seqs dispatched to
+    the current incarnation whose replies the merge still owes."""
+
+    __slots__ = ("idx", "inc", "state", "restarts", "inflight", "eos_sent",
+                 "remaps", "merged")
+
+    def __init__(self, idx: int, inc: _Incarnation, str_slots):
+        self.idx = idx
+        self.inc = inc
+        self.state = "up"
+        self.restarts = 0
+        self.inflight: set = set()
+        self.eos_sent = False
+        self.merged = 0
+        self.remaps = [_Remap() if s else None for s in str_slots]
+
+
+class _LaneGone(Exception):
+    """The lane died while the producer was mid-dispatch to it."""
 
 
 def build_ingest_plane(
@@ -129,6 +241,9 @@ def build_ingest_plane(
     for k, t in zip(plan.record_kinds, plan.tables):
         str_slots.append(k == STR)
         tables.append(t if k == STR else None)
+    extra = cfg.extra or {}
+    stall_ms = float(getattr(cfg, "ingest_lane_stall_limit_ms", 0.0))
+    inj = extra.get("fault_injector")
     plane = IngestPlane(
         lanes=lanes,
         spec=LaneSpec(exprs, kinds, str_slots),
@@ -139,21 +254,29 @@ def build_ingest_plane(
         job_obs=job_obs,
         fault=fault,
         skip_lines=skip_lines,
-        ring_bytes=int(
-            (cfg.extra or {}).get("ingest_ring_bytes", DEFAULT_RING_BYTES)
-        ),
+        ring_bytes=int(extra.get("ingest_ring_bytes", DEFAULT_RING_BYTES)),
+        stall_limit_s=max(0.0, stall_ms) / 1000.0,
+        restart_budget=int(getattr(cfg, "ingest_lane_restarts", 0)),
+        watchdog_limit_s=float(
+            extra.get(
+                "ingest_watchdog_limit_ms", max(30_000.0, 4.0 * stall_ms)
+            )
+        ) / 1000.0,
+        fault_points=list(getattr(inj, "points", ()) or ()),
     )
     job_obs.flight.record("ingest_lanes_enabled", lanes=lanes)
     return plane
 
 
 class IngestPlane:
-    """N lane worker processes + the deterministic merge point."""
+    """N supervised lane worker processes + the deterministic merge."""
 
     def __init__(
         self, lanes: int, spec: LaneSpec, global_tables: list,
         has_ts: bool, record_kinds: list, record_tables: list,
         job_obs, fault, skip_lines: int, ring_bytes: int,
+        stall_limit_s: float = 0.0, restart_budget: int = 0,
+        watchdog_limit_s: float = 30.0, fault_points: Optional[list] = None,
     ):
         import multiprocessing as mp
 
@@ -166,6 +289,9 @@ class IngestPlane:
         self._job_obs = job_obs
         self._fault = fault
         self._skip_left = int(skip_lines)
+        self._ring_bytes = ring_bytes
+        self._stall_limit_s = stall_limit_s
+        self._policy = LaneRestartPolicy(restart_budget)
 
         # fork when the platform has it: the worker inherits the already-
         # imported parse modules and skips spawn's re-exec of the user's
@@ -175,51 +301,32 @@ class IngestPlane:
         # light and the gate's lazy __getattr__ keeps user scripts
         # importable.
         try:
-            ctx = mp.get_context("fork")
+            self._ctx = mp.get_context("fork")
         except ValueError:
-            ctx = mp.get_context("spawn")
-        self._stop_ev = ctx.Event()
-        self._in_rings: List[ShmRing] = []
-        self._out_rings: List[ShmRing] = []
-        self._in_qs = []
-        self._out_qs = []
-        self._ack_in_qs = []
-        self._ack_out_qs = []
-        self._workers = []
-        for i in range(lanes):
-            in_ring = ShmRing(ring_bytes)
-            out_ring = ShmRing(ring_bytes)
-            in_q, out_q = ctx.Queue(), ctx.Queue()
-            ack_in, ack_out = ctx.Queue(), ctx.Queue()
-            self._in_rings.append(in_ring)
-            self._out_rings.append(out_ring)
-            self._in_qs.append(in_q)
-            self._out_qs.append(out_q)
-            self._ack_in_qs.append(ack_in)
-            self._ack_out_qs.append(ack_out)
-            self._workers.append(
-                spawn_lane(
-                    ctx, i, spec,
-                    (in_ring.name, ring_bytes, out_ring.name, ring_bytes,
-                     in_q, out_q, ack_in, ack_out, self._stop_ev),
-                )
-            )
+            self._ctx = mp.get_context("spawn")
+        self._lane_faults = self._build_lane_faults(fault_points or [])
 
-        # merge/producer shared state
-        self._cv = threading.Condition()
-        self._meta: dict = {}         # seq -> ("host"|"lane", SourceBatch)
+        # merge/producer shared state. The lock is re-entrant: lane
+        # recovery runs under the condition variable from code paths
+        # that already hold it (_scan_lanes inside the wait loops).
+        self._cv = threading.Condition(threading.RLock())
+        self._meta: dict = {}   # seq -> ("host"|"lane", _Lane|None, sb)
         self._produced = 0
         self._merged = 0
         self._eos: Optional[int] = None
         self._perror = None           # (seq, exception) from the producer
         self._producer: Optional[threading.Thread] = None
         self._closed = False
-        self._lane_merged = [0] * lanes
         self._host_frames = 0
-        # per-(lane, str-slot) id remap: lane-local id -> global plan id
-        self._remaps = [
-            [_Remap() if s else None for s in spec.str_slots]
-            for _ in range(lanes)
+        self._rr = 0                  # round-robin cursor over live lanes
+        self._degraded = False        # all lanes folded -> inline path
+        self._stalled = None          # (scope, limit_s) once the watchdog fires
+        self._pphase = "route"        # producer phase, for watchdog guards
+        self._graveyard: List[ShmRing] = []  # dead incarnations' rings
+
+        self._lanes: List[_Lane] = [
+            _Lane(i, self._spawn_incarnation(i, gen=0), spec.str_slots)
+            for i in range(lanes)
         ]
 
         enabled = getattr(job_obs, "enabled", False)
@@ -234,9 +341,208 @@ class IngestPlane:
             if enabled else None
             for i in range(lanes)
         ]
+        self._restart_counters = [
+            job_obs.group.group(lane=str(i)).counter(
+                "ingest_lane_restarts_total"
+            ) if enabled else None
+            for i in range(lanes)
+        ]
         self._stall_hist = (
             job_obs.histogram("ingest_lane_stall_ms") if enabled else None
         )
+        if enabled:
+            for lane in self._lanes:
+                g = job_obs.group.group(lane=str(lane.idx))
+                g.gauge("ingest_lane_folded").set(0)
+                # heartbeat age is a pull gauge: scrapes read the live
+                # worker clock; a folded/done lane reads -1
+                g.gauge("ingest_heartbeat_age_ms").set_fn(
+                    lambda lane=lane: (
+                        lane.inc.heartbeat_age_s() * 1000.0
+                        if lane.state == "up" else -1.0
+                    )
+                )
+
+        # plane-level stall escalation: a wedged producer or merge wait
+        # (not just a dead worker) surfaces as IngestStallError instead
+        # of hanging the job forever
+        self._watchdog_limit_s = watchdog_limit_s
+        self._watchdog = StallWatchdog(self._on_watchdog_fire)
+        job_obs.flight.record(
+            "watchdog_armed",
+            scopes=["merge_wait", "producer_ring"],
+            limit_ms=round(watchdog_limit_s * 1000.0, 1),
+            stall_limit_ms=round(stall_limit_s * 1000.0, 1),
+            lane_restart_budget=self._policy.budget,
+        )
+
+    # -- lane lifecycle ------------------------------------------------------
+
+    def _build_lane_faults(self, fault_points) -> tuple:
+        """Picklable lane fault specs from the installed FaultInjector's
+        points, duck-typed (the runtime never imports testing/faults).
+        The shared fire counter is cached ON the FaultPoint object so a
+        spent budget survives worker respawns and supervised restarts —
+        both replay the sequence numbers that already fired."""
+        specs = []
+        for fp in fault_points:
+            point = getattr(fp, "point", None)
+            at = getattr(fp, "at", None)
+            if point not in _LANE_FAULT_POINTS or at is None:
+                continue
+            fires = getattr(fp, "_lane_fires", None)
+            if fires is None:
+                fires = self._ctx.Value("i", 0)
+                try:
+                    fp._lane_fires = fires
+                except Exception:
+                    pass
+            specs.append((
+                point, int(at), int(getattr(fp, "times", 1)),
+                int(getattr(fp, "exit_code", 1)), fires,
+            ))
+        return tuple(specs)
+
+    def _spawn_incarnation(self, lane_idx: int, gen: int) -> _Incarnation:
+        return _Incarnation(
+            self._ctx, lane_idx, gen, self.spec, self._ring_bytes,
+            self._lane_faults,
+        )
+
+    def _scan_lanes(self) -> None:
+        """Detect the three lane failure shapes (call with _cv held, on
+        every wait tick): nonzero exit, premature clean exit (exit 0
+        before this lane's ``eos`` was sent), heartbeat stall past the
+        limit with work outstanding. Detection hands straight to
+        :meth:`_recover_lane` — the caller's wait loop then re-evaluates
+        its condition against the rewritten metadata."""
+        now = time.monotonic()
+        for lane in self._lanes:
+            if lane.state != "up":
+                continue
+            proc = lane.inc.proc
+            if not proc.is_alive():
+                code = proc.exitcode
+                if code == 0 and lane.eos_sent:
+                    continue  # legitimate: drained its frames, saw eos
+                shape = "premature_exit" if code == 0 else "exit"
+                self._recover_lane(lane, shape, exitcode=code)
+            elif (
+                self._stall_limit_s > 0.0
+                and lane.inflight
+                and now - lane.inc.heartbeat.value > self._stall_limit_s
+            ):
+                self._recover_lane(
+                    lane, "stall",
+                    heartbeat_age_ms=round(
+                        (now - lane.inc.heartbeat.value) * 1000.0, 1
+                    ),
+                )
+
+    def _recover_lane(self, lane: _Lane, shape: str, **info) -> None:
+        """In-place lane recovery (call with _cv held).
+
+        1. Re-route: every retained, un-merged frame assigned to this
+           lane is rewritten to the inline host path at its exact
+           sequence position (the producer kept the raw SourceBatch in
+           ``_meta``) — output bytes and exactly-once are untouched.
+        2. Reap the dead incarnation (its rings go to the graveyard:
+           the producer may still be inside a write to them).
+        3. Respawn a fresh incarnation while the LaneRestartPolicy
+           budget lasts, else fold the lane out permanently; all lanes
+           folded degrades the whole plane to the inline path.
+        """
+        flight = self._job_obs.flight
+        rerouted = 0
+        for s, (mode, l, sb) in list(self._meta.items()):
+            if mode == "lane" and l is lane:
+                self._meta[s] = ("host", None, sb)
+                rerouted += 1
+        lane.inflight.clear()
+        flight.record(
+            "ingest_lane_died",
+            lane=lane.idx, gen=lane.inc.gen, shape=shape,
+            rerouted_frames=rerouted, **info,
+        )
+        self._reap(lane.inc)
+        if self._eos is not None:
+            # nothing will ever be assigned past EOS: a respawn would
+            # only idle, so retire the lane without burning budget
+            lane.state = "done"
+        elif self._policy.may_restart(lane.idx):
+            n = self._policy.note_restart(lane.idx)
+            lane.restarts = n
+            lane.remaps = [
+                _Remap() if s else None for s in self.spec.str_slots
+            ]
+            lane.inc = self._spawn_incarnation(lane.idx, gen=lane.inc.gen + 1)
+            lane.eos_sent = False
+            lane.state = "up"
+            c = self._restart_counters[lane.idx]
+            if c is not None:
+                c.inc()
+            flight.record(
+                "ingest_lane_restarted",
+                lane=lane.idx, gen=lane.inc.gen, restarts=n,
+                budget=self._policy.budget,
+            )
+        else:
+            lane.state = "folded"
+            if getattr(self._job_obs, "enabled", False):
+                self._job_obs.group.group(lane=str(lane.idx)).gauge(
+                    "ingest_lane_folded"
+                ).set(1)
+            flight.record(
+                "ingest_lane_folded",
+                lane=lane.idx, restarts=lane.restarts,
+                budget=self._policy.budget,
+            )
+            if not any(l.state == "up" for l in self._lanes):
+                self._degraded = True
+                flight.record("ingest_degraded", lanes=self.lanes)
+        self._cv.notify_all()
+
+    def _reap(self, inc: _Incarnation) -> None:
+        """Terminate + join a dead incarnation and retire its resources.
+        Rings are NOT closed here — the producer thread may be inside a
+        write to the input ring's buffer; they close with the plane."""
+        inc.stop_ev.set()
+        proc = inc.proc
+        try:
+            if proc.is_alive():
+                proc.terminate()
+            proc.join(timeout=2.0)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=1.0)
+        except Exception:
+            pass
+        for q in (inc.in_q, inc.out_q, inc.ack_in, inc.ack_out):
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:
+                pass
+        self._graveyard.extend((inc.in_ring, inc.out_ring))
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _on_watchdog_fire(self, scope: str, limit_s: float) -> None:
+        """Runs on the watchdog thread: flag the stall and wake every
+        waiter — the stalled loops raise IngestStallError on their own
+        threads, which escalates through frames() to the supervisor."""
+        with self._cv:
+            if self._stalled is None and not self._closed:
+                self._stalled = (scope, limit_s)
+                self._job_obs.flight.record(
+                    "watchdog_fired", scope=scope,
+                    limit_ms=round(limit_s * 1000.0, 1),
+                )
+            self._cv.notify_all()
+
+    def _raise_if_stalled(self) -> None:
+        if self._stalled is not None:
+            raise IngestStallError(*self._stalled)
 
     # -- producer -----------------------------------------------------------
 
@@ -254,17 +560,25 @@ class IngestPlane:
     def _producer_main(self, source_batches) -> None:
         seq = 0
         try:
-            for sb in source_batches:
+            it = iter(source_batches)
+            while True:
+                self._pphase = "source"
+                try:
+                    sb = next(it)
+                except StopIteration:
+                    break
+                self._pphase = "route"
                 with self._cv:
                     while (
                         self._produced - self._merged
                         >= _MAX_AHEAD_FRAMES * self.lanes
                         and not self._closed
+                        and self._stalled is None
                     ):
                         self._cv.wait(0.2)
-                    if self._closed:
+                    if self._closed or self._stalled is not None:
                         return
-                mode = "host"
+                mode, lane, inc = "host", None, None
                 if self._skip_left > 0:
                     # resume replay: the executor's _prepare owns the
                     # line-exact trim; frames route inline until the
@@ -273,46 +587,109 @@ class IngestPlane:
                 else:
                     payload = self._frame_payload(sb)
                     if payload is not None:
-                        data, n = payload
-                        lane = seq % self.lanes
-                        ring = self._in_rings[lane]
-                        if ring.fits(len(data)):
-                            off, cost = ring.write(
-                                data,
-                                lambda: self._credit(
-                                    self._ack_in_qs[lane]
-                                ),
-                            )
-                            self._in_qs[lane].put(
-                                ("frame", seq, off, cost, len(data), n)
-                            )
-                            g = self._occ_gauges[lane]
-                            if g is not None:
-                                g.set(ring.size - ring.free)
+                        lane, inc = self._dispatch(seq, payload)
+                        if lane is not None:
                             mode = "lane"
                 with self._cv:
-                    self._meta[seq] = (mode, sb)
+                    if lane is not None and (
+                        lane.state != "up" or lane.inc is not inc
+                    ):
+                        # the lane died between the ring write and this
+                        # commit (recovery may even have respawned it):
+                        # the bytes sit in a graveyard ring no worker
+                        # will read, so this frame goes inline too
+                        mode, lane = "host", None
+                    if mode == "lane":
+                        lane.inflight.add(seq)
+                    self._meta[seq] = (mode, lane, sb)
                     self._produced += 1
                     self._cv.notify_all()
                 seq += 1
+            self._pphase = "done"
             with self._cv:
                 self._eos = seq
+                # a worker may exit 0 only after eos: send it to every
+                # live lane so legitimate exits are distinguishable from
+                # the premature-clean-exit failure shape
+                for lane in self._lanes:
+                    if lane.state == "up" and not lane.eos_sent:
+                        try:
+                            lane.inc.in_q.put(("eos",))
+                        except Exception:
+                            pass
+                        lane.eos_sent = True
                 self._cv.notify_all()
         except BaseException as e:
+            self._pphase = "done"
+            if isinstance(e, _LaneGone):
+                e = RuntimeError(f"ingest producer aborted: {e}")
             with self._cv:
-                self._perror = (seq, e)
+                if self._stalled is None and not self._closed:
+                    self._perror = (seq, e)
                 self._cv.notify_all()
 
-    def _credit(self, q):
-        """One ring credit, aborting when the plane is closing."""
+    def _next_live_lane(self) -> Optional[_Lane]:
+        """Round-robin over lanes still standing (call with _cv held)."""
+        for k in range(self.lanes):
+            lane = self._lanes[(self._rr + k) % self.lanes]
+            if lane.state == "up":
+                self._rr = (self._rr + k + 1) % self.lanes
+                return lane
+        return None
+
+    def _dispatch(self, seq: int, payload):
+        """Frame one payload into a live lane's input ring; returns
+        ``(lane, incarnation)`` or ``(None, None)`` to route the frame
+        inline (no live lane, or the frame never fits). A lane dying
+        mid-write aborts the write and the frame tries the next
+        survivor — each configured slot at most once."""
+        data, n = payload
+        for _ in range(self.lanes):
+            with self._cv:
+                if self._degraded or self._stalled is not None:
+                    return None, None
+                lane = self._next_live_lane()
+                if lane is None:
+                    return None, None
+                inc = lane.inc
+            if not inc.in_ring.fits(len(data)):
+                return None, None
+            self._pphase = "ring"
+            tok = self._watchdog.arm("producer_ring", self._watchdog_limit_s)
+            try:
+                off, cost = inc.in_ring.write(
+                    data, lambda: self._credit(lane, inc)
+                )
+                inc.in_q.put(("frame", seq, off, cost, len(data), n))
+            except _LaneGone:
+                continue  # recovery owns the lane; try a survivor
+            finally:
+                self._watchdog.disarm(tok)
+                self._pphase = "route"
+            # dispatch stamps the heartbeat too: a long-idle lane's last
+            # worker-side stamp may predate the gap, and the stall clock
+            # must start at hand-off, not at the previous frame
+            inc.heartbeat.value = time.monotonic()
+            g = self._occ_gauges[lane.idx]
+            if g is not None:
+                g.set(inc.in_ring.size - inc.in_ring.free)
+            return lane, inc
+        return None, None
+
+    def _credit(self, lane: _Lane, inc: _Incarnation):
+        """One input-ring credit, aborting when the plane is closing or
+        THIS incarnation is gone (died, respawned, or folded)."""
         import queue as _queue
 
         while True:
             try:
-                return q.get(timeout=0.2)
+                return inc.ack_in.get(timeout=0.2)
             except _queue.Empty:
-                if self._closed or self._stop_ev.is_set():
+                if self._closed or self._stalled is not None:
                     raise RuntimeError("ingest plane closed")
+                with self._cv:
+                    if lane.state != "up" or lane.inc is not inc:
+                        raise _LaneGone(f"lane {lane.idx} died")
 
     # -- merge --------------------------------------------------------------
 
@@ -331,23 +708,41 @@ class IngestPlane:
             seq = 0
             while True:
                 with self._cv:
-                    while (
+                    self._raise_if_stalled()
+                    if (
                         seq not in self._meta
                         and (self._eos is None or seq < self._eos)
                         and self._perror is None
                     ):
-                        self._cv.wait(0.5)
-                        self._check_workers()
+                        # the producer is quiet: watch the wait, but let
+                        # a paced/idle SOURCE be quiet for free — only a
+                        # producer wedged past the source counts
+                        tok = self._watchdog.arm(
+                            "merge_wait", self._watchdog_limit_s,
+                            guard=lambda: self._pphase != "source",
+                        )
+                        try:
+                            while (
+                                seq not in self._meta
+                                and (self._eos is None or seq < self._eos)
+                                and self._perror is None
+                                and self._stalled is None
+                            ):
+                                self._cv.wait(0.5)
+                                self._scan_lanes()
+                        finally:
+                            self._watchdog.disarm(tok)
+                        self._raise_if_stalled()
                     if seq not in self._meta:
                         if self._perror is not None:
                             raise self._perror[1]
                         break  # end of stream
-                    mode, sb = self._meta.pop(seq)
+                    mode, lane, sb = self._meta.pop(seq)
                 if mode == "host":
                     self._host_frames += 1
                     yield prepare(sb)
                 else:
-                    yield self._merge_lane_frame(seq, sb, prepare)
+                    yield self._merge_lane_frame(seq, lane, sb, prepare)
                 with self._cv:
                     self._merged += 1
                     self._cv.notify_all()
@@ -355,34 +750,55 @@ class IngestPlane:
         finally:
             self.close()
 
-    def _check_workers(self) -> None:
-        for i, w in enumerate(self._workers):
-            if not w.is_alive() and w.exitcode not in (0, None):
-                raise RuntimeError(
-                    f"ingest lane {i} worker died (exit {w.exitcode})"
-                )
-
-    def _next_from_lane(self, lane: int):
+    def _next_from_lane(self, seq: int, lane: _Lane):
+        """The next descriptor from ``lane``, or ``(None, None)`` when
+        the lane died and recovery re-routed ``seq`` inline. Returns the
+        incarnation the descriptor came from — its output ring holds the
+        payload even if the lane has respawned since."""
         import queue as _queue
 
-        q = self._out_qs[lane]
-        while True:
-            try:
-                return q.get(timeout=0.5)
-            except _queue.Empty:
-                self._check_workers()
+        tok = self._watchdog.arm("merge_wait", self._watchdog_limit_s)
+        try:
+            while True:
+                with self._cv:
+                    self._raise_if_stalled()
+                    if lane.state != "up" or seq not in lane.inflight:
+                        return None, None
+                    inc = lane.inc
+                try:
+                    desc = inc.out_q.get(timeout=0.5)
+                except _queue.Empty:
+                    with self._cv:
+                        self._scan_lanes()
+                    continue
+                if desc[0] == "err":
+                    # a worker-side exception is a lane failure, not a
+                    # job failure: recover (re-route + respawn/fold)
+                    # exactly like a crash
+                    with self._cv:
+                        if lane.state == "up" and lane.inc is inc:
+                            self._recover_lane(
+                                lane, "error", error=str(desc[2])[:200]
+                            )
+                    return None, None
+                with self._cv:
+                    lane.inflight.discard(desc[1])
+                return desc, inc
+        finally:
+            self._watchdog.disarm(tok)
 
-    def _merge_lane_frame(self, seq: int, sb, prepare):
+    def _merge_lane_frame(self, seq: int, lane: _Lane, sb, prepare):
         t_wait = time.perf_counter()
-        desc = self._next_from_lane(seq % self.lanes)
+        desc, inc = self._next_from_lane(seq, lane)
         if self._stall_hist is not None:
             self._stall_hist.observe(
                 (time.perf_counter() - t_wait) * 1000.0
             )
-        if desc[0] == "err":
-            raise RuntimeError(
-                f"ingest lane {desc[1]} failed: {desc[2]}"
-            )
+        if desc is None:
+            # the lane died under this frame: its retained SourceBatch
+            # re-parses inline at this exact sequence position
+            self._host_frames += 1
+            return prepare(sb)
         if desc[0] == "host":
             # the lane could not take this frame (blank lines defeating
             # the native plan, oversized packed output): inline parse at
@@ -400,18 +816,17 @@ class IngestPlane:
                 f"ingest lane frame out of order: expected seq {seq}, "
                 f"got {dseq}"
             )
-        lane = seq % self.lanes
         job_obs = self._job_obs
         with job_obs.tracer.span("parse"), Stopwatch() as hw:
             if self._fault is not None:
                 self._fault("parse")
-            payload = self._out_rings[lane].read(off, nbytes)
-            self._ack_out_qs[lane].put(cost)
+            payload = inc.out_ring.read(off, nbytes)
+            inc.ack_out.put(cost)
             cols = unpack_columns(metas, self.spec.kinds, payload, n)
             # lane-local interned ids -> the job's plan tables, extended
             # in frame order: global id assignment order equals the
             # single-lane first-appearance order
-            remaps = self._remaps[lane]
+            remaps = lane.remaps
             for j, news in enumerate(new_strings):
                 if remaps[j] is None:
                     continue
@@ -436,12 +851,12 @@ class IngestPlane:
             # ingest plane
             now = time.perf_counter()
             job_obs.tracer._record(
-                "lane_parse", -1, f"lane{lane}", now - dur, dur
+                "lane_parse", -1, f"lane{lane.idx}", now - dur, dur
             )
-        c = self._rec_counters[lane]
+        c = self._rec_counters[lane.idx]
         if c is not None:
             c.inc(n)
-        self._lane_merged[lane] += 1
+        lane.merged += 1
         return sb, batch, None, hw
 
     # -- checkpoint / shutdown ---------------------------------------------
@@ -449,12 +864,19 @@ class IngestPlane:
     def cursor(self) -> dict:
         """Per-lane frame cursor for checkpoint meta: which frames the
         merge has consumed. Frames still in a ring are NOT in the source
-        cursor either, so recovery replays them exactly once."""
+        cursor either, so recovery replays them exactly once. The
+        supervision fields are informational (no FORMAT_VERSION change):
+        restore never needs them — a restored plane starts fresh."""
         return {
             "lanes": self.lanes,
             "merged_frames": self._merged,
-            "lane_frames": list(self._lane_merged),
+            "lane_frames": [lane.merged for lane in self._lanes],
             "host_frames": self._host_frames,
+            "lane_restarts": [lane.restarts for lane in self._lanes],
+            "lanes_folded": [
+                lane.idx for lane in self._lanes if lane.state == "folded"
+            ],
+            "degraded": self._degraded,
         }
 
     def close(self) -> None:
@@ -463,27 +885,28 @@ class IngestPlane:
                 return
             self._closed = True
             self._cv.notify_all()
-        self._stop_ev.set()
-        for q in self._in_qs:
+        self._watchdog.close()
+        for lane in self._lanes:
+            lane.inc.stop_ev.set()
             try:
-                q.put(("stop",))
+                lane.inc.in_q.put(("stop",))
             except Exception:
                 pass
         if self._producer is not None:
             self._producer.join(timeout=3.0)
-        for w in self._workers:
-            w.join(timeout=5.0)
-        for w in self._workers:
-            if w.is_alive():
-                w.terminate()
-                w.join(timeout=2.0)
-        for q in (
-            self._in_qs + self._out_qs + self._ack_in_qs + self._ack_out_qs
-        ):
-            try:
-                q.close()
-                q.cancel_join_thread()
-            except Exception:
-                pass
-        for r in self._in_rings + self._out_rings:
+        for lane in self._lanes:
+            inc = lane.inc
+            inc.proc.join(timeout=5.0)
+            if inc.proc.is_alive():
+                inc.proc.terminate()
+                inc.proc.join(timeout=2.0)
+            for q in (inc.in_q, inc.out_q, inc.ack_in, inc.ack_out):
+                try:
+                    q.close()
+                    q.cancel_join_thread()
+                except Exception:
+                    pass
+            self._graveyard.extend((inc.in_ring, inc.out_ring))
+        for r in self._graveyard:
             r.close()
+        self._graveyard = []

@@ -124,6 +124,7 @@ class RestartStrategies:
 
 
 RESTART_HEALTH_RULE_NAME = "job_restarted"
+LANE_RESTART_HEALTH_RULE_NAME = "ingest_lane_restarted"
 
 
 class SupervisionState:
@@ -170,21 +171,20 @@ def _failure_cause(exc: BaseException) -> str:
     return getattr(exc, "point", None) or type(exc).__name__
 
 
-def _install_restart_health_rule(env) -> None:
-    """Built-in WARN rule: trips whenever the job has restarted at all
-    (evaluated at snapshot ticks and at job close). Skipped when the
-    user already configured a rule with this name."""
+def _install_builtin_health_rule(env, name: str, metric: str) -> None:
+    """One built-in WARN threshold rule (``sum(metric) > 0``), skipped
+    when the user already configured a rule with this name."""
     cfg = env.config
     rules = tuple(cfg.obs.health_rules or ())
     for r in rules:
-        name = r.get("name") if isinstance(r, dict) else getattr(r, "name", "")
-        if name == RESTART_HEALTH_RULE_NAME:
+        got = r.get("name") if isinstance(r, dict) else getattr(r, "name", "")
+        if got == name:
             return
     from ..obs.health import AlertRule
 
     rule = AlertRule(
-        name=RESTART_HEALTH_RULE_NAME,
-        metric="job_restarts_total",
+        name=name,
+        metric=metric,
         kind="threshold",
         op=">",
         value=0.0,
@@ -192,6 +192,25 @@ def _install_restart_health_rule(env) -> None:
         agg="sum",
     )
     env.config = cfg.replace(obs=cfg.obs.replace(health_rules=rules + (rule,)))
+
+
+def _install_restart_health_rule(env) -> None:
+    """Built-in WARN rule: trips whenever the job has restarted at all
+    (evaluated at snapshot ticks and at job close)."""
+    _install_builtin_health_rule(
+        env, RESTART_HEALTH_RULE_NAME, "job_restarts_total"
+    )
+
+
+def _install_lane_restart_health_rule(env) -> None:
+    """Built-in WARN rule for the self-healing ingest plane: trips once
+    any lane worker has been respawned in place. Lane recovery keeps the
+    job running with byte-identical output (no job restart), so without
+    this rule a lane quietly crash-looping toward fold-out would be
+    invisible outside the flight ring."""
+    _install_builtin_health_rule(
+        env, LANE_RESTART_HEALTH_RULE_NAME, "ingest_lane_restarts_total"
+    )
 
 
 def _layout_audit(env, sink_nodes, flight):

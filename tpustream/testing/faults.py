@@ -1,6 +1,6 @@
 """Seeded fault injection for supervised-execution tests and bench.
 
-The runtime exposes eight control-plane fault points, checked on the
+The runtime exposes ten control-plane fault points, checked on the
 paths named after them:
 
 * ``source_read``  — before each source batch enters the host stage
@@ -29,6 +29,29 @@ paths named after them:
   admission or rule change (see tpustream/tenancy and
   docs/multitenancy.md)
 
+Two further points target the sharded ingest plane's LANE WORKER
+PROCESSES (runtime/ingest.py lane supervision) and are evaluated inside
+the worker, not by :meth:`FaultInjector.check`:
+
+* ``lane_worker_crash`` — the worker holding frame ``at`` dies right
+  before parsing it: ``os._exit(exit_code)`` for ``exit_code >= 0``
+  (0 models the premature-clean-exit shape), or the signal
+  ``-exit_code`` delivered to itself for negative values (``-9`` = a
+  real SIGKILL, the OOM-killer shape)
+* ``lane_worker_hang`` — the worker holding frame ``at`` stops dead
+  (sleeps without stamping its heartbeat) until the plane kills it:
+  exercises heartbeat stall detection and, with detection disabled,
+  the StallWatchdog escalation path
+
+For lane points ``at`` is the producer's global frame SEQUENCE number
+(attempt-local) and ``times`` widens the window to ``[at, at+times)``;
+``p`` is not supported (worker-side draws would not be deterministic
+across respawns). The fire budget lives in shared memory on the
+injector's FaultPoint, so a respawned worker — or a supervised restart
+replaying the same sequence numbers — never re-triggers a spent fault.
+Lane fires do not appear in ``FaultInjector.log`` (they happen in a
+child process); assert on the plane's flight breadcrumbs instead.
+
 An injector installs into ``StreamConfig.extra["fault_injector"]`` (use
 :meth:`FaultInjector.install`); the executor reads it from there so the
 runtime never imports this module. The injector OUTLIVES supervised
@@ -56,7 +79,13 @@ FAULT_POINTS = (
     "sink_emit",
     "control_apply",
     "tenant_apply",
+    "lane_worker_crash",
+    "lane_worker_hang",
 )
+
+#: fault points evaluated INSIDE ingest lane worker processes, not by
+#: FaultInjector.check — see the module docstring
+LANE_FAULT_POINTS = ("lane_worker_crash", "lane_worker_hang")
 
 
 class FaultInjected(RuntimeError):
@@ -85,18 +114,27 @@ class FaultPoint:
     fully deterministic). ``p``: per-occurrence fire probability when
     ``at`` is None (seeded). ``times``: total fires before the point
     goes dormant (1 = fail once, then the restarted attempt sails
-    through — the standard recovery-test shape).
+    through — the standard recovery-test shape). ``exit_code``: lane
+    points only — how ``lane_worker_crash`` dies (>= 0: os._exit code,
+    0 models premature clean exit; < 0: self-delivered signal, -9 = a
+    real SIGKILL).
     """
 
     point: str
     at: Optional[int] = None
     p: float = 0.0
     times: int = 1
+    exit_code: int = 1
 
     def __post_init__(self):
         if self.point not in FAULT_POINTS:
             raise ValueError(
                 f"unknown fault point {self.point!r}; one of {FAULT_POINTS}"
+            )
+        if self.point in LANE_FAULT_POINTS and self.at is None:
+            raise ValueError(
+                f"{self.point} needs a positional at= frame seq; p-based "
+                "draws inside a lane worker would not be deterministic"
             )
 
 
