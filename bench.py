@@ -340,6 +340,76 @@ def obs_snapshot_probe():
     )
 
 
+def trace_overhead_probe():
+    """Phase O2: record flight-path tracing cost + parity (ISSUE 16).
+    Runs the phase-O tiny chapter3 job twice — obs-on with markers but
+    no record tracing, then the same job with trace_sample_rate=0.01
+    (the documented 1% production setting) — and reports the wall-clock
+    overhead of the tracing leg, whether the collected rows stayed
+    byte-identical (markers and traces are control events, never
+    records), and a trimmed unified timeline so r08's flamecharts ship
+    with the numbers."""
+    from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.obs import timeline_from_snapshot
+    from tpustream.runtime.sources import ReplaySource
+
+    lines = [
+        f"2020-01-01T00:{m:02d}:{s:02d} ch{(m * 12 + s) % 3} "
+        f"{100 + (m * 60 + s) % 997}"
+        for m in range(3)
+        for s in range(0, 60, 5)
+    ]
+
+    def run(rate):
+        cfg = StreamConfig(
+            batch_size=16,
+            key_capacity=64,
+            obs=ObsConfig(
+                enabled=True,
+                latency_marker_interval_ms=0.001,
+                trace_sample_rate=rate,
+            ),
+        )
+        env = StreamExecutionEnvironment(cfg)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        out = build(
+            env,
+            env.add_source(ReplaySource(lines)),
+            size=Time.minutes(5),
+            slide=Time.seconds(5),
+            delay=Time.minutes(1),
+        ).collect()
+        t0 = time.perf_counter()
+        env.execute("trace-probe")
+        wall = time.perf_counter() - t0
+        return wall, out.items, env.metrics
+
+    base_wall, base_rows, _ = run(0.0)
+    trace_wall, trace_rows, m = run(0.01)
+    snap = m.obs_snapshot(meta={"phase": "O2"})
+    timeline = timeline_from_snapshot(snap) or {}
+    events = timeline.get("traceEvents", [])
+    overhead = (
+        (trace_wall - base_wall) / base_wall * 100.0 if base_wall else 0.0
+    )
+    return {
+        "sample_rate": 0.01,
+        "base_wall_s": round(base_wall, 6),
+        "trace_wall_s": round(trace_wall, 6),
+        "overhead_pct": round(overhead, 3),
+        "sink_digest_base": _sink_digest(base_rows),
+        "sink_digest_traced": _sink_digest(trace_rows),
+        "output_identical": _sink_digest(base_rows) == _sink_digest(trace_rows),
+        "record_traces_total": snap.get("record_traces_total", 0),
+        "timeline_meta": timeline.get("meta", {}),
+        # the timeline itself, trimmed so the JSON tail stays readable
+        "timeline_events_head": events[:64],
+        "timeline_events_total": len(events),
+    }
+
+
 def recovery_probe():
     """Phase R: supervised-execution probe (docs/recovery.md). Runs a
     small checkpointed chapter2 job twice — clean, then with an injected
@@ -2199,6 +2269,20 @@ def main():
         compile_summary = state_memory = None
         log(f"phase O skipped: {e}")
 
+    # ---- Phase O2: record flight-path tracing overhead ------------------
+    tracing = None
+    try:
+        tracing = trace_overhead_probe()
+        log(
+            f"phase O2: record tracing at 1% sampling -> "
+            f"{tracing['overhead_pct']:+.1f}% wall overhead, "
+            f"{tracing['record_traces_total']} flight path(s) captured, "
+            f"{tracing['timeline_events_total']} timeline events, "
+            f"output identical: {tracing['output_identical']}"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase O2 skipped: {e}")
+
     # ---- Phase R: supervised recovery probe -----------------------------
     recovery = None
     try:
@@ -2352,6 +2436,11 @@ def main():
                     # probe job (docs/observability.md; render with
                     # `python -m tpustream.obs.dump`)
                     "obs_snapshot": obs_snap,
+                    # phase O2: record flight-path tracing — the 1%-
+                    # sampling wall overhead, the byte-identical-output
+                    # proof, and a trimmed unified Perfetto timeline
+                    # (docs/observability.md "Flight-path tracing")
+                    "tracing": tracing,
                     # phase R: what supervised execution costs and
                     # delivers after an injected mid-stream crash
                     # (docs/recovery.md)

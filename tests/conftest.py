@@ -101,6 +101,9 @@ _SLOW_TESTS = {
     # deep-pipeline parity on the p=8 mesh (fast single-chip parity
     # stays tier-1 in test_pipeline_parity.py)
     "test_sharded_pipeline_parity_p8",
+    # tracing-on/off output parity on the p=8 mesh (single-chip parity
+    # stays tier-1 in test_tracing_export.py)
+    "test_trace_parity_sharded_p8",
     "test_count_window_sharded_matches_single_chip",
     "test_sliding_count_window_sharded_matches_single_chip",
     "test_count_window_process_sharded_matches_single_chip",

@@ -503,6 +503,40 @@ def test_tsm017_clean_configurations():
     ]
 
 
+def test_tsm018_trace_sampling_without_marker_carrier():
+    # sampling on, but obs disabled: nothing can carry the trace probes
+    env = good_job(make_env(obs=ObsConfig(trace_sample_rate=0.01)))
+    f = next(f for f in env.analyze() if f.code == "TSM018")
+    assert f.severity == ERROR
+    # obs on but the marker interval is zero: same dead letterbox
+    env = good_job(make_env(obs=ObsConfig(
+        enabled=True, latency_marker_interval_ms=0.0,
+        trace_sample_rate=0.01,
+    )))
+    assert "TSM018" in codes(env.analyze())
+
+
+def test_tsm018_rate_outside_unit_interval():
+    env = good_job(make_env(obs=ObsConfig(
+        enabled=True, latency_marker_interval_ms=100.0,
+        trace_sample_rate=5.0,
+    )))
+    f = next(f for f in env.analyze() if f.code == "TSM018")
+    assert f.severity == WARN
+
+
+def test_tsm018_clean_configurations():
+    # sampling off entirely: silent
+    env = good_job(make_env(obs=ObsConfig(enabled=True)))
+    assert "TSM018" not in codes(env.analyze())
+    # sampling with a live marker carrier and a sane rate: silent
+    env = good_job(make_env(obs=ObsConfig(
+        enabled=True, latency_marker_interval_ms=100.0,
+        trace_sample_rate=0.01,
+    )))
+    assert "TSM018" not in codes(env.analyze())
+
+
 def test_findings_sorted_errors_first():
     # one ERROR (TSM013) + one INFO (TSM010) in a single graph
     env = make_env(async_depth=2)
@@ -705,8 +739,8 @@ def test_catalog_is_stable():
     expected = {
         "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
         "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
-        "TSM013", "TSM014", "TSM015", "TSM016", "TSM017", "TSM020",
-        "TSM021",
+        "TSM013", "TSM014", "TSM015", "TSM016", "TSM017", "TSM018",
+        "TSM020", "TSM021",
         "TSM022", "TSM023", "TSM024", "TSM025", "TSM030", "TSM031",
         "TSM032", "TSM033", "TSM034", "TSM040", "TSM041", "TSM042",
         "TSM043", "TSM044", "TSM045", "TSM046", "TSM047",

@@ -259,7 +259,7 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 77
+    assert int(m.group(1)) == 91
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     # ... and the sharded-ingestion lane series
@@ -277,6 +277,11 @@ def test_dump_selftest_smoke(capsys):
     assert "ok: prometheus carries the lane supervision series" in out
     assert "ok: flight keeps the degradation ladder in order" in out
     assert "ok: flight keeps the checkpoint_audit breadcrumb" in out
+    # the unified Perfetto timeline checks are part of the suite
+    assert "ok: record lineage spans source->sink" in out
+    assert "ok: flight events export as instants" in out
+    assert "ok: tracer ring overflow counts drops" in out
+    assert "ok: /trace.json serves the timeline" in out
 
 
 # ---------------------------------------------------------------------------

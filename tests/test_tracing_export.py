@@ -1,0 +1,278 @@
+"""Record-level flight-path tracing + the unified Perfetto timeline
+(tpustream/obs/tracing_export.py): Chrome-trace JSON shape goldens over
+canned parts, deterministic stride sampling, the bounded record-trace
+log, an end-to-end lanes>=2 job whose timeline carries device-step
+spans, per-lane spans, a source->sink record lineage and flight-event
+instants, byte-identical-output parity with tracing on vs off (single
+chip tier-1; the p=8 variant rides the slow tier), and the dump CLI's
+--trace mode."""
+
+import json
+
+import jax
+import pytest
+
+from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.jobs.chapter3_bandwidth_eventtime import build as build_et
+from tpustream.obs import RecordTrace, RecordTraceLog, MarkerStamper
+from tpustream.obs.dump import main as dump_main
+from tpustream.obs.flightrecorder import FlightRecorder
+from tpustream.obs.tracing import StepTracer
+from tpustream.obs.tracing_export import (
+    NULL_TRACE_LOG,
+    PID_DEVICE,
+    PID_LANES,
+    PID_RECORDS,
+    timeline_from_parts,
+    timeline_from_snapshot,
+)
+from tpustream.runtime.sources import ReplaySource
+
+
+# ---------------------------------------------------------------------------
+# golden: Chrome-trace JSON shape from canned parts (no device work)
+# ---------------------------------------------------------------------------
+
+
+def _canned_parts():
+    tr = StepTracer(capacity=64)
+    tr._epoch = 100.0
+    tr._record("pack", 1, "window", 100.01, 0.002)
+    tr._record("dispatch", 1, "window", 100.02, 0.010)
+    tr._record("fetch", 1, "window", 100.04, 0.030)
+    tr._record("lane_parse", -1, "lane0", 100.005, 0.004)
+    tr._record("lane_parse", -1, "lane1", 100.006, 0.004)
+    flight = FlightRecorder(capacity=8)
+    flight._t0 = 100.0
+    flight.record("watermark_jump", from_ms=0, to_ms=99, jump_ms=99)
+    rt = RecordTrace(marker_id=3, trace_id=2, source_offset=5,
+                     tenant="acme", born_s=100.001)
+    rt.add_span("pack", t0=100.012, dur=0.002, step=1)
+    rt.add_span("device_step", t0=100.020, dur=0.010, step=1)
+    rt.add_span("sink0", t0=100.070, dur=0.0, age_ms=69.0)
+    log = RecordTraceLog(8)
+    log.add(rt)
+    return tr, flight, log
+
+
+def test_timeline_golden_shape():
+    tr, flight, log = _canned_parts()
+    tl = timeline_from_parts(
+        tr.events(), flight_events=flight.events(),
+        record_traces=log.traces(), tracer_epoch_s=tr.epoch,
+        flight_epoch_s=100.0,
+    )
+    # valid JSON, loadable the way Perfetto loads it
+    blob = json.dumps(tl)
+    loaded = json.loads(blob)
+    assert loaded["displayTimeUnit"] == "ms"
+    evs = loaded["traceEvents"]
+    assert evs, "timeline must carry events"
+    # every event has the Chrome-trace envelope
+    for e in evs:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] != "M":
+            assert e["ts"] >= 0
+    # non-metadata events are ts-sorted (monotonic timeline)
+    ts = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    # complete events carry a duration, instants a scope
+    for e in evs:
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+        elif e["ph"] == "i":
+            assert e["s"] in ("p", "t")
+    # pid layout: device spans, lane spans (one tid per lane), lineage
+    assert any(e["pid"] == PID_DEVICE and e["ph"] == "X"
+               and e["name"] == "dispatch" for e in evs)
+    lane_tids = {e["tid"] for e in evs
+                 if e["pid"] == PID_LANES and e["ph"] == "X"}
+    assert lane_tids == {1, 2}
+    rec = [e for e in evs if e["pid"] == PID_RECORDS and e["ph"] != "M"]
+    assert [e["name"] for e in rec][0] == "source"
+    assert [e["name"] for e in rec][-1] == "sink0"
+    assert all(e["args"]["trace_id"] == 2 for e in rec)
+    # flight events are process-scoped instants on the device track
+    assert any(e["ph"] == "i" and e["pid"] == PID_DEVICE
+               and e["name"] == "watermark_jump" for e in evs)
+    # track-naming metadata rides along
+    names = {(e["pid"], e["args"]["name"]) for e in evs if e["ph"] == "M"
+             and e["name"] == "process_name"}
+    assert (PID_DEVICE, "device pipeline") in names
+    assert (PID_LANES, "ingest lanes") in names
+    assert (PID_RECORDS, "record lineage") in names
+    assert tl["meta"]["n_record_traces"] == 1
+    assert tl["meta"]["n_lane_spans"] == 2
+    assert tl["meta"]["n_flight_instants"] == 1
+
+
+def test_timeline_from_snapshot_roundtrip():
+    tr, flight, log = _canned_parts()
+    snap = {
+        "trace": tr.snapshot(),
+        "trace_meta": {"tracer_epoch_s": tr.epoch, "flight_epoch_s": 100.0},
+        "flight_events": flight.events(),
+        "record_traces": log.traces(),
+    }
+    direct = timeline_from_parts(
+        tr.events(), flight_events=flight.events(),
+        record_traces=log.traces(), tracer_epoch_s=tr.epoch,
+        flight_epoch_s=100.0,
+    )
+    via_snap = timeline_from_snapshot(json.loads(json.dumps(snap)))
+    assert via_snap["meta"] == direct["meta"]
+    assert len(via_snap["traceEvents"]) == len(direct["traceEvents"])
+    # a snapshot without a trace section (obs off) yields no timeline
+    assert timeline_from_snapshot({"metrics": {"series": []}}) is None
+
+
+# ---------------------------------------------------------------------------
+# sampling + log bounds (no device work)
+# ---------------------------------------------------------------------------
+
+
+def test_stride_sampling_is_deterministic_and_bounded():
+    """The stamper samples by record stride, no RNG: two identical
+    replays pick the same records, and a batch mints at most one."""
+
+    def offsets():
+        st = MarkerStamper(1.0, trace_sample_rate=0.01)
+        out = []
+        for _ in range(10):
+            t = st.poll_trace(64)  # 640 records -> ~6 traces at 1%
+            if t is not None:
+                out.append((t.trace_id, t.source_offset))
+        return out
+
+    a, b = offsets(), offsets()
+    assert a == b
+    assert 1 <= len(a) <= 7
+    assert all(0 <= off < 64 for _, off in a)
+    # rate 0 never mints; rates are clamped into [0, 1]
+    assert MarkerStamper(1.0).poll_trace(10_000) is None
+    st = MarkerStamper(1.0, trace_sample_rate=7.5)  # clamped to 1.0
+    assert st.poll_trace(4) is not None
+
+
+def test_record_trace_log_is_bounded():
+    log = RecordTraceLog(2)
+    for i in range(5):
+        log.add({"trace_id": i, "spans": []})
+    assert log.total == 5
+    assert [t["trace_id"] for t in log.traces()] == [3, 4]
+    # the null twin has the same surface and does nothing
+    NULL_TRACE_LOG.add({"trace_id": 9})
+    assert NULL_TRACE_LOG.traces() == [] and NULL_TRACE_LOG.total == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: lanes>=2 job -> full lineage on one timeline
+# ---------------------------------------------------------------------------
+
+ET_LINES = [
+    f"2020-01-01T00:{m:02d}:{s:02d} ch{(m + s) % 3} {100 + (m * 60 + s) % 997}"
+    for m in range(4)
+    for s in range(60)
+]
+
+
+def _run_traced(sample_rate, lanes=1, parallelism=1):
+    obs = ObsConfig(
+        enabled=True,
+        latency_marker_interval_ms=1e-6 if sample_rate else 0.0,
+        trace_sample_rate=sample_rate,
+    )
+    cfg = StreamConfig(batch_size=16, key_capacity=64, obs=obs)
+    kw = {}
+    if lanes > 1:
+        kw["ingest_lanes"] = lanes
+    if parallelism > 1:
+        kw["parallelism"] = parallelism
+        kw["print_parallelism"] = 1
+    if kw:
+        cfg = cfg.replace(**kw)
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    h = build_et(
+        env,
+        env.add_source(ReplaySource(ET_LINES)),
+        size=Time.minutes(5),
+        slide=Time.seconds(5),
+        delay=Time.minutes(1),
+    ).collect()
+    env.execute("trace-e2e")
+    return env.metrics, [repr(t) for t in h.items]
+
+
+def test_traced_job_timeline_carries_all_tracks():
+    m, _ = _run_traced(1.0, lanes=2)
+    snap = m.obs_snapshot()
+    assert snap.get("record_traces"), "sampled lineage must reach the sink"
+    # each trace walked the full flight path, source -> sink
+    spans = [s["name"] for s in snap["record_traces"][0]["spans"]]
+    assert spans[0] == "source" and spans[-1] == "sink0"
+    assert "device_step" in spans and "pack" in spans
+    lane_traced = [
+        t for t in snap["record_traces"]
+        if any(s["name"] == "lane_parse" for s in t["spans"])
+    ]
+    assert lane_traced, "lane-parsed frames must carry the lane span"
+    la = next(s for t in lane_traced for s in t["spans"]
+              if s["name"] == "lane_parse")
+    assert la["args"]["lane"] in (0, 1) and la["args"]["frame_seq"] >= 0
+    # the unified timeline: valid JSON with every track populated
+    tl = timeline_from_snapshot(json.loads(json.dumps(snap, default=str)))
+    meta = tl["meta"]
+    assert meta["n_device_spans"] > 0
+    assert meta["n_lane_spans"] > 0
+    assert meta["n_record_traces"] > 0
+    assert meta["n_flight_instants"] > 0
+    evs = tl["traceEvents"]
+    assert any(e["pid"] == PID_LANES and e["ph"] == "X" for e in evs)
+    assert any(e["pid"] == PID_RECORDS and e["name"] == "sink0"
+               for e in evs)
+    # the sampling counter is a real registry series
+    sampled = [s for s in snap["metrics"]["series"]
+               if s["name"] == "record_traces_sampled_total"]
+    assert sampled and sampled[0]["value"] == snap["record_traces_total"]
+
+
+def test_trace_parity_single_chip():
+    """Tracing is a control-lane concern: output is byte-identical with
+    sampling at 100% vs fully off."""
+    _, on_rows = _run_traced(1.0)
+    _, off_rows = _run_traced(0.0)
+    assert on_rows, "the parity job must produce output"
+    assert on_rows == off_rows
+
+
+@pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-virtual-device CPU mesh"
+)
+def test_trace_parity_sharded_p8():
+    _, on_rows = _run_traced(1.0, parallelism=8)
+    _, off_rows = _run_traced(0.0, parallelism=8)
+    assert on_rows, "the parity job must produce output"
+    assert on_rows == off_rows
+
+
+# ---------------------------------------------------------------------------
+# dump CLI --trace
+# ---------------------------------------------------------------------------
+
+
+def test_dump_trace_mode(tmp_path, capsys):
+    m, _ = _run_traced(1.0)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(m.obs_snapshot(), default=str))
+    assert dump_main([str(path), "--trace"]) == 0
+    tl = json.loads(capsys.readouterr().out)
+    assert tl["displayTimeUnit"] == "ms"
+    assert any(e["pid"] == PID_RECORDS and e["name"] == "source"
+               for e in tl["traceEvents"])
+    # a traceless snapshot (obs disabled) exits 1 with a hint
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"metrics": {"series": []}}))
+    assert dump_main([str(bare), "--trace"]) == 1
+    assert "no trace section" in capsys.readouterr().out

@@ -233,6 +233,19 @@ CATALOG = {
             "2x max_batch_delay_ms (0 disables heartbeat detection)",
         ),
         Rule(
+            "TSM018", ERROR, "trace sampling has no marker carrier",
+            "record flight-path tracing (ObsConfig.trace_sample_rate) "
+            "promotes sampled records to RecordTrace probes that ride "
+            "the latency-marker side-channel; with obs disabled or "
+            "latency_marker_interval_ms == 0 the stamper is never "
+            "installed, so no trace is ever minted and /trace.json "
+            "silently carries no record lineage. A rate outside (0, 1] "
+            "is clamped, which usually means a percent/fraction mixup.",
+            "set ObsConfig.enabled = True and "
+            "latency_marker_interval_ms > 0 alongside trace_sample_rate, "
+            "and keep the rate in (0, 1] (e.g. 0.01 for 1%)",
+        ),
+        Rule(
             "TSM020", WARN, "nondeterministic call in a user function",
             "time/random/datetime/uuid calls make replay diverge: a "
             "supervised restart reprocesses records from the last "

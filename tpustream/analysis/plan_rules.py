@@ -637,6 +637,36 @@ def check_grouped_fetch_skew(ctx) -> Iterable[Finding]:
 
 
 @rule
+def check_trace_sampling_carrier(ctx) -> Iterable[Finding]:
+    """TSM018: record flight-path tracing configured without its
+    marker carrier, or with a rate that is not a fraction in (0, 1].
+    RecordTrace probes ride the latency-marker side-channel; without a
+    stamper installed no trace is ever minted, silently."""
+    obs = ctx.cfg.obs
+    rate = getattr(obs, "trace_sample_rate", 0.0)
+    if not rate:
+        return
+    if rate < 0 or rate > 1:
+        yield make_finding(
+            "TSM018", None,
+            f"trace_sample_rate={rate} is outside (0, 1]; the stamper "
+            "clamps it, which usually means a percent/fraction mixup "
+            "(1% is 0.01, not 1)",
+            severity=WARN,
+        )
+    if not obs.enabled or getattr(obs, "latency_marker_interval_ms", 0) <= 0:
+        yield make_finding(
+            "TSM018", None,
+            f"trace_sample_rate={rate} with "
+            f"obs.enabled={obs.enabled} and latency_marker_interval_ms="
+            f"{getattr(obs, 'latency_marker_interval_ms', 0)}: record "
+            "lineage rides the latency-marker side-channel, so no "
+            "marker stamper means no trace is ever minted — "
+            "/trace.json will carry no record lineage",
+        )
+
+
+@rule
 def check_unproduced_side_output(ctx) -> Iterable[Finding]:
     """TSM013: get_side_output(tag) where the parent never emits tag."""
     for chain in ctx.chains:

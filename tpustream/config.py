@@ -46,6 +46,20 @@ class ObsConfig:
     # source->here age into an e2e latency histogram. 0 (default) = no
     # stamper installed, SourceBatch.markers stays None, zero cost.
 
+    # -- sampled record flight-path tracing (obs/tracing_export.py) ---------
+    trace_sample_rate: float = 0.0
+    # > 0: the source stamper promotes roughly this fraction of records
+    # to RecordTrace probes (deterministic stride sampling, at most one
+    # per batch) that ride the latency-marker side-channel and collect a
+    # span per hop (source, lane_parse, merge, pack, h2d, device_step,
+    # fetch, emit, sink). Requires latency_marker_interval_ms > 0 — the
+    # markers are the carrier (analyzer rule TSM018 enforces this). The
+    # sink-side span trees land in JobObs.traces and the /trace.json
+    # Perfetto timeline. 0 (default) = no record lineage, zero cost.
+    trace_max_records: int = 256
+    # bounded ring of completed record traces retained at the sink
+    # (oldest evicted); bounds memory for arbitrarily long jobs
+
     # -- per-tenant series bounding (docs/multitenancy.md) ------------------
     tenant_series_topk: int = 64
     # fleets label latency/SLO series per tenant; only the top-K active

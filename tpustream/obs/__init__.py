@@ -28,7 +28,12 @@ the TPU-runtime equivalent:
 * :mod:`tpustream.obs.latency` — end-to-end latency markers (Flink's
   ``LatencyMarker``): source-stamped probes that ride the data path so
   each operator edge and sink gets a true source→here latency
-  histogram, pipelining included.
+  histogram, pipelining included; plus sampled ``RecordTrace`` probes
+  (``ObsConfig.trace_sample_rate``) that collect a span per hop.
+* :mod:`tpustream.obs.tracing_export` — unified Chrome-trace/Perfetto
+  timeline export: StepTracer spans, ingest-lane spans, flight-event
+  instants and sampled record flight paths on one timeline
+  (``/trace.json``, ``dump --trace``).
 * :mod:`tpustream.obs.health` — declarative ``AlertRule`` set
   (threshold / rate-of-change / absence over any registry series)
   evaluated at snapshot ticks by a ``HealthEngine`` running an
@@ -77,7 +82,18 @@ from .timeseries import TimeSeries  # noqa: F401
 from .tracing import NULL_TRACER, StepTracer  # noqa: F401
 from .profiler import PipelineProfiler  # noqa: F401
 from .snapshot import Snapshotter, job_snapshot, write_snapshot  # noqa: F401
-from .latency import LatencyMarker, MarkerStamper, stamp_markers  # noqa: F401
+from .latency import (  # noqa: F401
+    LatencyMarker,
+    MarkerStamper,
+    RecordTrace,
+    stamp_markers,
+)
+from .tracing_export import (  # noqa: F401
+    NULL_TRACE_LOG,
+    RecordTraceLog,
+    timeline_from_parts,
+    timeline_from_snapshot,
+)
 from .health import AlertRule, HealthEngine, as_rule  # noqa: F401
 from .flightrecorder import (  # noqa: F401
     FlightRecorder,

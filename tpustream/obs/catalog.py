@@ -65,6 +65,8 @@ KNOWN_SERIES = frozenset({
     # continuous profiler
     "profile_stage_ms", "profile_stage_share", "profile_occupancy",
     "profile_binding_stage", "profile_spans_dropped",
+    # record flight-path tracing (obs/tracing_export.py)
+    "trace_spans_dropped_total", "record_traces_sampled_total",
     # analyzer
     "analysis_findings_total",
     # multi-tenant fleet (docs/multitenancy.md)

@@ -435,6 +435,153 @@ def _selftest_profile() -> list:
     return checks
 
 
+def _selftest_trace() -> list:
+    """Checks for the unified Perfetto timeline (obs/tracing_export.py):
+    crafted device/lane spans, flight instants and a record flight path
+    folded into Chrome-trace JSON, plus the span-drop accounting and a
+    live /trace.json round-trip."""
+    import json as _json
+    import urllib.request
+
+    from .flightrecorder import FlightRecorder
+    from .latency import RecordTrace
+    from .serve import MetricsServer
+    from .tracing import StepTracer
+    from .tracing_export import (
+        PID_DEVICE,
+        PID_LANES,
+        PID_RECORDS,
+        RecordTraceLog,
+        timeline_from_parts,
+        timeline_from_snapshot,
+    )
+
+    checks = []
+    tr = StepTracer(capacity=64)
+    tr._epoch = 100.0  # absolute-time spans for determinism
+    tr._record("pack", 1, "window", 100.01, 0.002)
+    tr._record("dispatch", 1, "window", 100.02, 0.010)
+    tr._record("fetch", 1, "window", 100.04, 0.030)
+    tr._record("lane_parse", -1, "lane0", 100.005, 0.004)
+    tr._record("lane_parse", -1, "lane1", 100.006, 0.004)
+    flight = FlightRecorder(capacity=8)
+    flight._t0 = 100.0
+    flight.record("serve_started", host="127.0.0.1", port=0)
+    rt = RecordTrace(marker_id=1, trace_id=1, source_offset=7,
+                     tenant="acme", born_s=100.001)
+    rt.spans.clear()
+    rt.spans.append({"name": "source", "t0_s": 100.001, "dur_s": 0.0,
+                     "args": {"offset": 7}})
+    rt.add_span("lane_parse", t0=100.005, dur=0.004, lane=0, frame_seq=0)
+    rt.add_span("merge", t0=100.010, dur=0.001)
+    rt.add_span("pack", t0=100.012, dur=0.002, step=1)
+    rt.add_span("device_step", t0=100.020, dur=0.010, step=1)
+    rt.add_span("fetch", t0=100.040, dur=0.030)
+    rt.add_span("sink0", t0=100.071, dur=0.0, age_ms=70.0)
+    log = RecordTraceLog(4)
+    log.add(rt)
+    tl = timeline_from_parts(
+        tr.events(), flight_events=flight.events(),
+        record_traces=log.traces(), tracer_epoch_s=tr.epoch,
+        flight_epoch_s=100.0, meta={"job": "selftest"},
+    )
+    blob = _json.dumps(tl)
+    rt2 = _json.loads(blob)
+    evs = rt2["traceEvents"]
+    slices = [e for e in evs if e["ph"] != "M"]
+    ts_list = [e["ts"] for e in slices]
+    checks.append(("timeline serializes and reloads",
+                   rt2["displayTimeUnit"] == "ms" and len(evs) > 0))
+    checks.append(("every event carries ph/ts/pid/tid",
+                   all(all(k in e for k in ("ph", "pid", "tid"))
+                       for e in evs)
+                   and all("ts" in e for e in slices)))
+    checks.append(("timestamps are non-negative and sorted",
+                   all(t >= 0 for t in ts_list)
+                   and ts_list == sorted(ts_list)))
+    checks.append(("device spans land on the device track",
+                   any(e["pid"] == PID_DEVICE and e["ph"] == "X"
+                       and e["name"] == "dispatch" for e in evs)))
+    checks.append(("lane spans get one tid per lane",
+                   {e["tid"] for e in evs
+                    if e["pid"] == PID_LANES and e["ph"] == "X"}
+                   == {1, 2}))
+    checks.append(("flight events export as instants",
+                   any(e["ph"] == "i" and e["pid"] == PID_DEVICE
+                       and e["name"] == "serve_started" for e in evs)))
+    rec = [e for e in evs if e["pid"] == PID_RECORDS and e["ph"] != "M"]
+    rec_names = [e["name"] for e in rec]
+    checks.append(("record lineage spans source->sink",
+                   rec_names[0] == "source" and rec_names[-1] == "sink0"
+                   and "device_step" in rec_names))
+    checks.append(("lineage spans carry the trace id",
+                   all(e["args"].get("trace_id") == 1 for e in rec)))
+    checks.append(("timeline meta counts the tracks",
+                   tl["meta"]["n_record_traces"] == 1
+                   and tl["meta"]["n_lane_spans"] == 2
+                   and tl["meta"]["n_flight_instants"] == 1))
+    # snapshot round-trip: the same parts via the snapshot shape
+    snap = {
+        "trace": tr.snapshot(),
+        "trace_meta": {"tracer_epoch_s": tr.epoch,
+                       "flight_epoch_s": 100.0},
+        "flight_events": flight.events(),
+        "record_traces": log.traces(),
+    }
+    tl2 = timeline_from_snapshot(snap)
+    checks.append(("snapshot rebuilds the same timeline",
+                   tl2 is not None
+                   and tl2["meta"]["n_record_traces"] == 1
+                   and tl2["meta"]["n_device_spans"]
+                   == tl["meta"]["n_device_spans"]))
+    checks.append(("snapshot without trace yields no timeline",
+                   timeline_from_snapshot({"metrics": {}}) is None))
+    # span-drop accounting: overflow counts + fires the one-shot hook
+    class _Ctr:
+        n = 0
+
+        def inc(self, v=1):
+            self.n += v
+
+    small = StepTracer(capacity=2)
+    small.drop_counter = _Ctr()
+    fired = []
+    small.on_first_drop = lambda: fired.append(1)
+    for i in range(5):
+        small._record("pack", i, "w", float(i), 0.001)
+    checks.append(("tracer ring overflow counts drops",
+                   small.drop_counter.n == 3))
+    checks.append(("first drop fires the flight hook once",
+                   fired == [1]))
+
+    # live /trace.json round-trip on an ephemeral port
+    class _TraceProvider:
+        health = None
+
+        def to_prometheus_text(self):
+            return ""
+
+        def snapshot(self):
+            return dict(snap)
+
+        def trace_timeline(self):
+            return timeline_from_snapshot(snap)
+
+    srv = MetricsServer(_TraceProvider(), port=0)
+    srv.start()
+    try:
+        served = _json.loads(urllib.request.urlopen(
+            srv.url + "/trace.json", timeout=5
+        ).read().decode("utf-8"))
+    finally:
+        srv.close()
+    checks.append(("/trace.json serves the timeline",
+                   served["meta"]["n_record_traces"] == 1
+                   and any(e.get("name") == "source"
+                           for e in served["traceEvents"])))
+    return checks
+
+
 def _selftest() -> int:
     """CI smoke mode: a canned registry (hostile labels included) runs
     through snapshot -> render -> Prometheus exposition -> health
@@ -770,6 +917,7 @@ def _selftest() -> int:
     ]
     checks.extend(_selftest_timeseries())
     checks.extend(_selftest_profile())
+    checks.extend(_selftest_trace())
     failed = [name for name, ok in checks if not ok]
     for name, ok in checks:
         sys.stdout.write(f"{'ok' if ok else 'FAIL'}: {name}\n")
@@ -813,6 +961,13 @@ def main(argv=None) -> int:
         "(binding stage, per-stage shares, occupancy)",
     )
     ap.add_argument(
+        "--trace",
+        action="store_true",
+        help="emit the unified Chrome-trace/Perfetto timeline JSON "
+        "(StepTracer spans, lane spans, flight instants, sampled "
+        "record flight paths); load it at ui.perfetto.dev",
+    )
+    ap.add_argument(
         "--tenants",
         action="store_true",
         help="show only the per-tenant fleet view (tenant-labeled "
@@ -851,6 +1006,17 @@ def main(argv=None) -> int:
         sys.stdout.write(out)
         if out.startswith("no tenant-labeled"):
             return 1
+    elif args.trace:
+        from .tracing_export import timeline_from_snapshot
+
+        timeline = timeline_from_snapshot(snap)
+        if timeline is None:
+            sys.stdout.write(
+                "no trace section in this snapshot (requires "
+                "ObsConfig.enabled with trace on)\n"
+            )
+            return 1
+        sys.stdout.write(json.dumps(timeline, default=str) + "\n")
     elif args.profile:
         prof = snap.get("profile")
         if not prof:

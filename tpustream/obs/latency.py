@@ -71,6 +71,70 @@ class LatencyMarker:
         return age
 
 
+@dataclass
+class RecordTrace(LatencyMarker):
+    """A latency marker promoted to a full flight-path probe.
+
+    Stands in for one sampled record (``source_offset`` is the record's
+    offset within its source batch) and rides the exact same marker
+    side-channel — excluded from operator semantics, so output is
+    byte-identical with tracing on or off. On top of the ``(edge,
+    age_ms)`` hop trace it accumulates ``spans``: dicts with absolute
+    ``perf_counter`` start times so the exporter can place them on the
+    same timeline as StepTracer spans and flight events. Every
+    :meth:`observe` edge crossing (operator edges, ``sinkN``) also
+    becomes a zero-duration span, so the pump chain needs no extra hooks.
+    """
+
+    trace_id: int = 0
+    source_offset: int = -1
+    born_s: float = 0.0          # perf_counter at birth (exporter clock)
+    spans: list = field(default_factory=list)
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not self.born_s:
+            self.born_s = time.perf_counter()
+        self.spans.append({
+            "name": "source", "t0_s": self.born_s, "dur_s": 0.0,
+            "args": {"offset": self.source_offset, "tenant": self.tenant},
+        })
+
+    def add_span(self, name: str, t0: float = 0.0, dur: float = 0.0,
+                 **attrs) -> None:
+        self.spans.append({
+            "name": name,
+            "t0_s": t0 or time.perf_counter(),
+            "dur_s": dur,
+            "args": attrs,
+        })
+
+    def add_host_parse(self, t0: float, dur: float) -> None:
+        """The main-loop parse/merge span for this trace's batch. Named
+        ``merge`` when an ingest lane already parsed the frame (the
+        main-loop work is then the seq-ordered merge), ``parse`` on the
+        inline host path."""
+        laned = any(s["name"] == "lane_parse" for s in self.spans)
+        self.add_span("merge" if laned else "parse", t0=t0, dur=dur)
+
+    def observe(self, edge: str, now_ns: int = 0) -> float:
+        age = super().observe(edge, now_ns)
+        self.add_span(edge, dur=0.0, age_ms=round(age, 3))
+        return age
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "marker_id": self.marker_id,
+            "source": self.source,
+            "source_offset": self.source_offset,
+            "tenant": self.tenant,
+            "born_s": self.born_s,
+            "spans": list(self.spans),
+            "trace": list(self.trace),
+        }
+
+
 class MarkerStamper:
     """Decides when the next marker is due and mints it.
 
@@ -82,7 +146,8 @@ class MarkerStamper:
     """
 
     def __init__(self, interval_ms: float, source: str = "source",
-                 counter=None, tenant_provider=None):
+                 counter=None, tenant_provider=None,
+                 trace_sample_rate: float = 0.0, trace_counter=None):
         self.interval_s = max(0.0, float(interval_ms)) / 1000.0
         self.source = source
         self._counter = counter      # obs Counter: markers emitted
@@ -92,6 +157,43 @@ class MarkerStamper:
         # marker (the JobServer installs a round-robin over its active
         # tenants, bounded to top-K + "__other__"). None = unlabeled.
         self.tenant_provider = tenant_provider
+        # record flight-path sampling: promote ~rate of records to
+        # RecordTrace probes. Deterministic stride (no RNG) so a replay
+        # of the same input samples the same records.
+        rate = min(1.0, max(0.0, float(trace_sample_rate)))
+        self.trace_sample_rate = rate
+        self._trace_stride = int(round(1.0 / rate)) if rate > 0 else 0
+        self._trace_counter = trace_counter
+        self._records_seen = 0
+        self._next_trace_at = 0      # record index of the next sample
+        self._next_trace_id = 0
+
+    def poll_trace(self, n_records: int):
+        """-> RecordTrace if the sampling stride lands inside the next
+        ``n_records`` records, else None. At most one trace per batch —
+        lineage wants representative records, not bursts — so the stride
+        boundary is advanced past the whole batch either way."""
+        if not self._trace_stride or n_records <= 0:
+            return None
+        start = self._records_seen
+        self._records_seen = start + n_records
+        if self._next_trace_at >= self._records_seen:
+            return None
+        offset = max(0, self._next_trace_at - start)
+        self._next_trace_at = self._records_seen + self._trace_stride - 1
+        self._next_trace_id += 1
+        self._next_id += 1
+        tenant = (
+            self.tenant_provider() if self.tenant_provider is not None
+            else None
+        )
+        t = RecordTrace(
+            marker_id=self._next_id, source=self.source, tenant=tenant,
+            trace_id=self._next_trace_id, source_offset=offset,
+        )
+        if self._trace_counter is not None:
+            self._trace_counter.inc()
+        return t
 
     def poll(self, now_s: float = 0.0):
         """-> LatencyMarker if one is due at ``now_s`` (monotonic
@@ -125,4 +227,9 @@ def stamp_markers(batches, stamper: MarkerStamper):
             if batch.markers is None:
                 batch.markers = []
             batch.markers.append(m)
+        t = stamper.poll_trace(getattr(batch, "n_records", 0))
+        if t is not None:
+            if batch.markers is None:
+                batch.markers = []
+            batch.markers.append(t)
         yield batch

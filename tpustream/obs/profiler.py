@@ -49,6 +49,10 @@ class PipelineProfiler:
         }
         self._consumed = 0  # tracer.total_spans already drained
         self.dropped = 0    # spans the tracer ring evicted before drain
+        # FlightRecorder, set by JobObs post-construction: the FIRST
+        # drain that loses spans leaves one breadcrumb (never spams)
+        self.flight = None
+        self._drop_breadcrumbed = False
         if group is not None:
             self._binding_gauge = group.gauge("profile_binding_stage")
             self._occupancy_gauge = group.gauge("profile_occupancy")
@@ -83,6 +87,15 @@ class PipelineProfiler:
         if lost > 0:
             self.dropped += lost
             self._dropped_counter.inc(lost)
+            if self.flight is not None and not self._drop_breadcrumbed:
+                self._drop_breadcrumbed = True
+                try:
+                    self.flight.record(
+                        "profile_spans_dropped", lost=lost,
+                        capacity=getattr(self.tracer, "capacity", 0),
+                    )
+                except Exception:
+                    pass
         epoch = getattr(self.tracer, "epoch", 0.0)
         for (kind, _step, _op, t0, dur) in evs:
             ser = self.series.get(kind)

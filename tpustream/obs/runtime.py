@@ -22,6 +22,7 @@ from .health import HealthEngine
 from .registry import MetricsRegistry, NULL_COUNTER, NULL_GAUGE, NULL_HISTOGRAM
 from .snapshot import Snapshotter, job_snapshot
 from .tracing import NULL_TRACER, StepTracer
+from .tracing_export import NULL_TRACE_LOG, RecordTraceLog, timeline_from_parts
 
 
 class OperatorObs:
@@ -103,6 +104,9 @@ class JobObs:
         self.job_name = str(job_name)
         self.group = self.registry.group(job=self.job_name)
         self.tracer = StepTracer(ring, bridge) if trace else NULL_TRACER
+        # completed record flight paths (obs/tracing_export.py): the
+        # executor's terminal stage feeds this when trace_sample_rate>0
+        self.traces = RecordTraceLog(getattr(cfg, "trace_max_records", 256))
         self.snapshotter = Snapshotter(
             self.registry,
             self.tracer,
@@ -135,6 +139,18 @@ class JobObs:
             else NULL_FLIGHT
         )
         self.flight_dump_path = getattr(cfg, "flight_dump_path", "") or ""
+        # span-drop accounting: tracer/profiler ring overflow counts
+        # into trace_spans_dropped_total and leaves ONE flight
+        # breadcrumb instead of silently losing spans
+        if self.tracer.enabled:
+            self.tracer.drop_counter = self.group.counter(
+                "trace_spans_dropped_total"
+            )
+            self.tracer.on_first_drop = lambda: self.flight.record(
+                "trace_spans_dropped", capacity=self.tracer.capacity
+            )
+        if self.profiler is not None:
+            self.profiler.flight = self.flight
 
         # self-monitoring health engine (obs/health.py); rule state
         # gauges land in the job group so they are ordinary series
@@ -212,7 +228,38 @@ class JobObs:
             snap["profile"] = prof
         if self.health is not None:
             snap["health"] = self.health.state()
+        # flight-path tracing extras, so dump --trace can rebuild the
+        # unified timeline offline (obs/tracing_export.py)
+        if self.tracer.enabled:
+            snap["trace_meta"] = {
+                "tracer_epoch_s": round(self.tracer.epoch, 6),
+                "flight_epoch_s": (
+                    round(self.flight._t0, 6)
+                    if self.flight.enabled else None
+                ),
+            }
+            if self.flight.enabled:
+                snap["flight_events"] = self.flight.events()
+            if self.traces.total:
+                snap["record_traces"] = self.traces.traces()
+                snap["record_traces_total"] = self.traces.total
         return snap
+
+    def trace_timeline(self) -> Optional[dict]:
+        """The live unified Chrome-trace timeline (the /trace.json
+        body), or None when step tracing is disabled."""
+        if not self.tracer.enabled:
+            return None
+        return timeline_from_parts(
+            self.tracer.events(),
+            flight_events=self.flight.events() if self.flight.enabled else (),
+            record_traces=self.traces.traces(),
+            tracer_epoch_s=self.tracer.epoch,
+            flight_epoch_s=(
+                self.flight._t0 if self.flight.enabled else None
+            ),
+            meta={"job": self.job_name},
+        )
 
     def to_prometheus_text(self) -> str:
         return self.registry.to_prometheus_text()
@@ -362,6 +409,7 @@ class _NullJobObs:
     enabled = False
     registry = None
     tracer = NULL_TRACER
+    traces = NULL_TRACE_LOG
     job_name = ""
     snapshotter = None
     profiler = None
@@ -395,6 +443,9 @@ class _NullJobObs:
         return NULL_HISTOGRAM
 
     def maybe_snapshot(self):
+        return None
+
+    def trace_timeline(self):
         return None
 
     def snapshot(self, meta: Optional[dict] = None) -> dict:

@@ -11,6 +11,9 @@
   + the continuous profiler's ``profile`` section)
 * ``GET /profile.json``  — just the profiler's windowed stage
   attribution (binding stage, shares, occupancy), cheap to poll
+* ``GET /trace.json``    — the unified Chrome-trace/Perfetto timeline
+  (StepTracer spans, lane spans, flight instants, sampled record
+  flight paths; obs/tracing_export.py); load it at ui.perfetto.dev
 * ``GET /tenants.json``  — per-tenant fleet view (admission/emit/error
   rates, SLO levels, budget burn) when a JobServer is attached; 404 on
   single-job runs
@@ -131,6 +134,17 @@ class MetricsServer:
                 body = json.dumps(
                     profiler.profile(), default=str
                 ).encode("utf-8")
+                return 200, "application/json", body
+            if path == "/trace.json":
+                tl = getattr(self._provider, "trace_timeline", None)
+                timeline = tl() if tl is not None else None
+                if timeline is None:
+                    return (
+                        404,
+                        "application/json",
+                        b'{"error": "no trace (tracing disabled)"}',
+                    )
+                body = json.dumps(timeline, default=str).encode("utf-8")
                 return 200, "application/json", body
             return (
                 404,

@@ -78,6 +78,12 @@ class StepTracer:
         self._pos = 0
         self.total_spans = 0
         self._epoch = time.perf_counter()
+        # span-drop accounting, wired by JobObs post-construction: a
+        # Counter incremented per overwritten span, and a one-shot
+        # callable fired on the FIRST drop (flight breadcrumb) so ring
+        # overflow is never silent.
+        self.drop_counter = None
+        self.on_first_drop = None
         self._annotate = None
         if profiler_bridge:
             try:
@@ -95,6 +101,14 @@ class StepTracer:
         if len(self._ring) >= self.capacity:
             self._ring[self._pos] = ev
             self._pos = (self._pos + 1) % self.capacity
+            if self.drop_counter is not None:
+                self.drop_counter.inc()
+            if self.on_first_drop is not None:
+                hook, self.on_first_drop = self.on_first_drop, None
+                try:
+                    hook()
+                except Exception:
+                    pass
         else:
             self._ring.append(ev)
         self.total_spans += 1

@@ -340,6 +340,12 @@ def lane_worker_main(
       lines, oversized, no native parser): the producer-retained source
       batch takes the ordinary inline parse path at the merge point.
 
+    Input frames may carry an OPTIONAL 7th element: a tuple of record
+    trace ids (obs flight-path sampling rides the batch whose frame
+    this is). The worker echoes it back verbatim as an optional 10th
+    ``"frame"`` reply element so the merge can attribute the lane span
+    to those traces; untraced frames stay at the original arity.
+
     ``heartbeat`` (a shared double) is stamped per frame AND per idle /
     credit-wait tick, so the lane supervisor (runtime/ingest.py) reads
     a fresh timestamp from any healthy worker — idle, parsing, or
@@ -367,7 +373,8 @@ def lane_worker_main(
                 continue
             if msg[0] in ("stop", "eos"):
                 break
-            _, seq, off, cost, nbytes, n_lines = msg
+            _, seq, off, cost, nbytes, n_lines = msg[:6]
+            trace_ids = msg[6] if len(msg) > 6 else ()
             if faults:
                 _check_lane_faults(faults, seq)
             _stamp(heartbeat)
@@ -398,10 +405,11 @@ def lane_worker_main(
                 payload,
                 lambda: _drain_credit(ack_out_q, stop_ev, heartbeat=heartbeat),
             )
-            out_q.put(
-                ("frame", seq, off2, cost2, len(payload), n_lines,
-                 metas, new_strings, dur)
-            )
+            reply = ("frame", seq, off2, cost2, len(payload), n_lines,
+                     metas, new_strings, dur)
+            if trace_ids:
+                reply = reply + (trace_ids,)
+            out_q.put(reply)
             _stamp(heartbeat)
     except _LaneStop:
         pass

@@ -1049,6 +1049,14 @@ class Runner:
         cfg = self.cfg
         if markers:
             self._pending_markers.extend(markers)
+        # sampled flight-path probes get the pack hop timed; the span
+        # lands once (first sub-batch), on the batch they rode
+        traced = None
+        if self._pending_markers:
+            traced = [
+                m for m in self._pending_markers
+                if getattr(m, "trace_id", 0)
+            ] or None
         self._check_capacity()
         if self._state_mem is not None:
             self._state_mem.observe_batch(batch)
@@ -1068,10 +1076,17 @@ class Runner:
                 valid=batch.valid[start : start + cfg.batch_size],
             )
             padded = sub.pad_to(cfg.batch_size)
+            t0p = time.perf_counter() if traced is not None else 0.0
             with self.obs.span("pack", self._step_idx + 1):
                 inputs = self._device_inputs(
                     padded, self.plan.time_characteristic
                 )
+            if traced is not None:
+                dur = time.perf_counter() - t0p
+                for m in traced:
+                    m.add_span("pack", t0=t0p, dur=dur,
+                               step=self._step_idx + 1)
+                traced = None
             self._stage_step(inputs, wm_lower, t_batch)
             if self.count_input:
                 self.metrics.records_in += int(sub.n)
@@ -1108,6 +1123,11 @@ class Runner:
             self._run_step(inputs, wm_lower, t_batch)
             return
         packed, bases, valid, ts_p, ts_b = inputs
+        traced = (
+            [m for m in self._pending_markers if getattr(m, "trace_id", 0)]
+            if self._pending_markers else ()
+        )
+        t0h = time.perf_counter() if traced else 0.0
         with self.obs.span("h2d", self._step_idx + len(self._upload_q) + 1):
             put = (
                 jax.device_put
@@ -1115,6 +1135,10 @@ class Runner:
                 else self._sharded_put
             )
             packed, valid, ts_p = put((packed, valid, ts_p))
+        if traced:
+            dur = time.perf_counter() - t0h
+            for m in traced:
+                m.add_span("h2d", t0=t0h, dur=dur)
         # markers detach at stage time so they ride THIS batch's step,
         # not whichever older batch the staging queue pops next
         if self._pending_markers:
@@ -1339,6 +1363,13 @@ class Runner:
             self._pending_markers = []
         else:
             step_markers = ()
+        for m in step_markers:
+            if getattr(m, "trace_id", 0):
+                m.add_span(
+                    "device_step", t0=sw.t0, dur=sw.elapsed,
+                    step=self._step_idx,
+                    operator=self.obs.name or self.program.operator_name,
+                )
         self._inflight.append(
             (emissions, counts, compact, t_batch, step_markers)
         )
@@ -1449,6 +1480,13 @@ class Runner:
         for i, h in enumerate(self._sink_e2e):
             for m in markers:
                 h.observe(m.observe(f"sink{i}", now_ns))
+        # sampled flight-path probes are complete at the terminal stage:
+        # their span trees land in the job's record-trace log (the
+        # /trace.json + dump --trace lineage track)
+        log = self.metrics.job_obs.traces
+        for m in markers:
+            if getattr(m, "trace_id", 0):
+                log.add(m)
 
     def settle_markers(self) -> None:
         """End of stream: no further steps will run, so record any
@@ -1887,6 +1925,10 @@ class Runner:
         self.obs.step_time_s.observe_many([per_entry] * len(entries))
         for (entry, pre, fetched) in zip(entries, pre_fetched, fetched_list):
             fetched.update(pre)
+            for m in entry[4]:
+                if getattr(m, "trace_id", 0):
+                    m.add_span("fetch", t0=sw.t0, dur=sw.elapsed,
+                               group=len(entries))
             self._dispatch(fetched, entry[3])
             if entry[4]:
                 self._record_markers(entry[4])
@@ -2920,6 +2962,13 @@ def _execute_job(env, sink_nodes) -> JobResult:
                     if _tenancy is not None
                     else None
                 ),
+                # sampled record flight paths ride the same channel:
+                # ~trace_sample_rate of records get a RecordTrace probe
+                # collecting a span per hop (obs/tracing_export.py)
+                trace_sample_rate=cfg.obs.trace_sample_rate,
+                trace_counter=job_obs.counter(
+                    "record_traces_sampled_total"
+                ),
             ),
         )
     prepared = map(_prepare, source_batches)
@@ -2974,6 +3023,11 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 batches_consumed=metrics.batches,
             )
         if sb.markers:
+            for m in sb.markers:
+                if getattr(m, "trace_id", 0):
+                    # the main-loop parse (inline path) or seq-ordered
+                    # merge (lane path) this batch just crossed
+                    m.add_host_parse(hw.t0, hw.elapsed)
             marker_backlog.extend(sb.markers)
         lines_consumed += sb.n_records
         metrics.host_times_s.append(hw.elapsed)

@@ -587,7 +587,14 @@ class IngestPlane:
                 else:
                     payload = self._frame_payload(sb)
                     if payload is not None:
-                        lane, inc = self._dispatch(seq, payload)
+                        # sampled flight-path probes riding this batch:
+                        # their ids travel in the frame's optional trace
+                        # slot so the merge can attribute the lane span
+                        tids = tuple(
+                            m.trace_id for m in (sb.markers or ())
+                            if getattr(m, "trace_id", 0)
+                        )
+                        lane, inc = self._dispatch(seq, payload, tids)
                         if lane is not None:
                             mode = "lane"
                 with self._cv:
@@ -637,7 +644,7 @@ class IngestPlane:
                 return lane
         return None
 
-    def _dispatch(self, seq: int, payload):
+    def _dispatch(self, seq: int, payload, trace_ids=()):
         """Frame one payload into a live lane's input ring; returns
         ``(lane, incarnation)`` or ``(None, None)`` to route the frame
         inline (no live lane, or the frame never fits). A lane dying
@@ -660,7 +667,10 @@ class IngestPlane:
                 off, cost = inc.in_ring.write(
                     data, lambda: self._credit(lane, inc)
                 )
-                inc.in_q.put(("frame", seq, off, cost, len(data), n))
+                frame = ("frame", seq, off, cost, len(data), n)
+                if trace_ids:
+                    frame = frame + (trace_ids,)
+                inc.in_q.put(frame)
             except _LaneGone:
                 continue  # recovery owns the lane; try a survivor
             finally:
@@ -810,7 +820,8 @@ class IngestPlane:
                 )
             self._host_frames += 1
             return prepare(sb)
-        _, dseq, off, cost, nbytes, n, metas, new_strings, dur = desc
+        _, dseq, off, cost, nbytes, n, metas, new_strings, dur = desc[:9]
+        trace_ids = desc[9] if len(desc) > 9 else ()
         if dseq != seq:
             raise RuntimeError(
                 f"ingest lane frame out of order: expected seq {seq}, "
@@ -853,6 +864,16 @@ class IngestPlane:
             job_obs.tracer._record(
                 "lane_parse", -1, f"lane{lane.idx}", now - dur, dur
             )
+            if trace_ids and sb.markers:
+                # attribute the worker-side parse to the flight-path
+                # probes riding this frame (obs/tracing_export.py)
+                want = set(trace_ids)
+                for m in sb.markers:
+                    if getattr(m, "trace_id", 0) in want:
+                        m.add_span(
+                            "lane_parse", t0=now - dur, dur=dur,
+                            lane=lane.idx, frame_seq=seq,
+                        )
         c = self._rec_counters[lane.idx]
         if c is not None:
             c.inc(n)

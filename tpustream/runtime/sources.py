@@ -56,7 +56,14 @@ class SourceBatch:
     batch's records do. Under a multi-tenant fleet each marker carries
     a tenant label (the JobServer's round-robin provider), so the
     source batch is also where per-tenant end-to-end latency samples
-    are born (docs/multitenancy.md).
+    are born (docs/multitenancy.md). With
+    ``ObsConfig.trace_sample_rate > 0`` a sampled batch also carries a
+    ``RecordTrace`` probe — a marker promoted to a full flight-path
+    trace that accumulates one span per hop (source, lane parse,
+    merge, pack, h2d, device step, fetch, sinks) for the unified
+    Perfetto timeline (obs/tracing_export.py). Markers and traces are
+    control events: excluded from operator semantics, so job output is
+    byte-identical with or without them.
     """
 
     lines: List[str]
