@@ -28,6 +28,7 @@ window sums still compose in int64 at fire.
 Prints ONE JSON line: metric/value/unit/vs_baseline. Detail -> stderr.
 """
 
+import collections
 import hashlib
 import json
 import sys
@@ -35,8 +36,17 @@ import time
 
 import numpy as np
 
+#: record format version: 2 added the env fingerprint header and the
+#: folded stderr tail (detail.stderr_tail); pre-2 records have neither
+BENCH_SCHEMA = 2
+
+# last N stderr lines, folded into the record as detail.stderr_tail so
+# a round's narrative survives without a committed bench_stderr.txt
+_LOG_TAIL = collections.deque(maxlen=60)
+
 
 def log(*a):
+    _LOG_TAIL.append(" ".join(str(x) for x in a))
     print(*a, file=sys.stderr, flush=True)
 
 
@@ -1677,7 +1687,305 @@ def measure_h2d():
     return rates[1]
 
 
-def main():
+# ---------------------------------------------------------------------------
+# bench --compare: per-phase deltas behind a comparability verdict
+# ---------------------------------------------------------------------------
+# Pure stdlib on purpose: comparing two BENCH files must not need jax,
+# a device, or even this repo's runtime — only the env-fingerprint
+# comparability logic is imported (lazily) from tpustream.obs.resources.
+
+#: |delta| beyond this on a directional phase counts as a regression /
+#: improvement; smaller moves are reported as noise-level
+REGRESSION_PCT = 10.0
+#: a lane sweep is inverse-scaling when the max-lane rate lands below
+#: this fraction of the single-lane rate
+INVERSE_SCALING_RATIO = 0.9
+
+_HIGHER_BETTER = ("_per_s", "_per_sec", "throughput")
+_LOWER_BETTER = ("_ms", "latency", "_s_p99", "overhead_pct")
+
+
+def _phase_direction(name: str):
+    """+1 higher-is-better, -1 lower-is-better, 0 no direction."""
+    n = name.lower()
+    if any(n.endswith(s) or s in n for s in _HIGHER_BETTER):
+        return 1
+    if any(n.endswith(s) for s in _LOWER_BETTER) or "latency" in n:
+        return -1
+    return 0
+
+
+def _flatten_phases(detail, prefix="", out=None):
+    """Numeric leaves of a record's detail dict, dotted-key flattened.
+    Lists are skipped (the lane sweep is handled structurally)."""
+    if out is None:
+        out = {}
+    if not isinstance(detail, dict):
+        return out
+    for k, v in detail.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            _flatten_phases(v, prefix=key + ".", out=out)
+    return out
+
+
+def _lane_sweep_results(detail):
+    """[(lanes, lines_per_s), ...] from an ingest_lane_sweep section
+    anywhere in the detail tree, or None."""
+    if not isinstance(detail, dict):
+        return None
+    sweep = detail.get("ingest_lane_sweep")
+    if isinstance(sweep, dict) and isinstance(sweep.get("results"), list):
+        out = []
+        for r in sweep["results"]:
+            if isinstance(r, dict) and "lanes" in r and "lines_per_s" in r:
+                out.append((int(r["lanes"]), float(r["lines_per_s"])))
+        if len(out) >= 2:
+            return sorted(out)
+    for v in detail.values():
+        if isinstance(v, dict):
+            found = _lane_sweep_results(v)
+            if found is not None:
+                return found
+    return None
+
+
+def load_bench_record(path):
+    """One BENCH artifact -> {path, env, phases, lane_sweep, error}.
+
+    Accepts both shapes in the repo's history: a raw record (the one
+    JSON line a bench run prints: metric/value/unit/detail, schema>=2
+    adds env) and the round wrapper ({n, cmd, rc, tail, parsed}) whose
+    record is either ``parsed`` or the last ``BENCH {json}`` line of
+    the stderr tail. A wrapper with neither (r05: the record line was
+    truncated) loads with ``error`` set and no env — which downstream
+    makes the round incomparable, never silently comparable."""
+    with open(path, "r") as f:
+        doc = json.load(f)
+    rec = doc
+    if isinstance(doc, dict) and "tail" in doc and "cmd" in doc:
+        rec = doc.get("parsed")
+        if not isinstance(rec, dict):
+            rec = None
+            for line in str(doc.get("tail", "")).splitlines():
+                if line.startswith("BENCH "):
+                    try:
+                        rec = json.loads(line[len("BENCH "):])
+                    except ValueError:
+                        pass
+        if rec is None:
+            return {
+                "path": path, "env": None, "phases": {},
+                "lane_sweep": None, "schema": 0,
+                "error": "no parseable BENCH record in round wrapper",
+            }
+    detail = {}
+    for key in ("detail", "round_detail"):
+        if isinstance(rec.get(key), dict):
+            detail = rec[key]
+            break
+    phases = _flatten_phases(detail)
+    if isinstance(rec.get("value"), (int, float)) and not isinstance(
+        rec.get("value"), bool
+    ):
+        phases["headline"] = float(rec["value"])
+    env = rec.get("env") if isinstance(rec.get("env"), dict) else None
+    return {
+        "path": path,
+        "env": env,
+        "phases": phases,
+        "lane_sweep": _lane_sweep_results(detail),
+        "schema": int(rec.get("bench_schema", 1) or 1),
+        "error": None,
+    }
+
+
+def check_lane_scaling(sweep):
+    """Inverse-scaling verdict over [(lanes, rate), ...]: more lanes
+    should never cost throughput. None when the sweep is absent."""
+    if not sweep:
+        return None
+    base_lanes, base_rate = sweep[0]
+    top_lanes, top_rate = sweep[-1]
+    inverse = (
+        base_rate > 0
+        and top_lanes > base_lanes
+        and top_rate < INVERSE_SCALING_RATIO * base_rate
+    )
+    return {
+        "inverse": bool(inverse),
+        "base": {"lanes": base_lanes, "rate": base_rate},
+        "top": {"lanes": top_lanes, "rate": top_rate},
+        "top_over_base": round(top_rate / base_rate, 3) if base_rate else None,
+    }
+
+
+def _env_comparability(old, new):
+    """(comparable, reasons) across two loaded records."""
+    reasons = []
+    for rec, which in ((old, "OLD"), (new, "NEW")):
+        if rec["error"]:
+            reasons.append(f"{which} {rec['path']}: {rec['error']}")
+        elif rec["env"] is None:
+            reasons.append(
+                f"{which} {rec['path']}: no environment fingerprint "
+                f"(pre-schema-2 record)"
+            )
+    if reasons:
+        return False, reasons
+    EnvFingerprint = _resources_module().EnvFingerprint
+    diff = EnvFingerprint.from_dict(old["env"]).comparability(
+        EnvFingerprint.from_dict(new["env"])
+    )
+    return (not diff), diff
+
+
+def _resources_module():
+    """tpustream/obs/resources.py loaded standalone (stdlib-only file),
+    so the compare path never pays the package's jax import."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tpustream", "obs", "resources.py",
+    )
+    spec = importlib.util.spec_from_file_location("tsm_obs_resources", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass field-type resolution looks the module up by name
+    sys.modules.setdefault("tsm_obs_resources", mod)
+    spec.loader.exec_module(mod)
+    return sys.modules["tsm_obs_resources"]
+
+
+def compare_records(old, new):
+    """The full comparison document for two loaded records."""
+    comparable, reasons = _env_comparability(old, new)
+    result = {
+        "old": old["path"],
+        "new": new["path"],
+        "comparable": comparable,
+        "verdict": "comparable" if comparable else "incomparable environments",
+        "reasons": reasons,
+        "deltas": [],
+        "regressions": [],
+        "improvements": [],
+        "lane_scaling_old": check_lane_scaling(old["lane_sweep"]),
+        "lane_scaling_new": check_lane_scaling(new["lane_sweep"]),
+    }
+    if not comparable:
+        return result
+    for name in sorted(set(old["phases"]) & set(new["phases"])):
+        a, b = old["phases"][name], new["phases"][name]
+        if a == 0:
+            continue
+        pct = (b - a) / abs(a) * 100.0
+        direction = _phase_direction(name)
+        entry = {
+            "phase": name, "old": a, "new": b, "delta_pct": round(pct, 2),
+        }
+        result["deltas"].append(entry)
+        if direction and abs(pct) >= REGRESSION_PCT:
+            regressed = pct < 0 if direction > 0 else pct > 0
+            (result["regressions"] if regressed
+             else result["improvements"]).append(entry)
+    return result
+
+
+def run_compare(paths, gate=False):
+    """CLI driver. Exit codes: 0 comparable (and gate clean), 1 file /
+    usage error, 2 gate failure (--gate with a regression or inverse
+    lane scaling), 3 incomparable environments."""
+    try:
+        records = [load_bench_record(p) for p in paths]
+    except (OSError, ValueError) as e:
+        log(f"compare: cannot load record: {e}")
+        return 1
+
+    if len(records) == 1:
+        rec = records[0]
+        scaling = check_lane_scaling(rec["lane_sweep"])
+        doc = {
+            "file": rec["path"],
+            "bench_schema": rec["schema"],
+            "env": rec["env"],
+            "error": rec["error"],
+            "phases": rec["phases"],
+            "lane_scaling": scaling,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        if scaling and scaling["inverse"]:
+            log(
+                f"compare: INVERSE LANE SCALING in {rec['path']}: "
+                f"{scaling['top']['lanes']} lanes at "
+                f"{scaling['top_over_base']}x the 1-lane rate"
+            )
+            if gate:
+                return 2
+        return 1 if rec["error"] else 0
+
+    old, new = records
+    result = compare_records(old, new)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    if not result["comparable"]:
+        log(
+            "compare: VERDICT incomparable environments — refusing any "
+            "speedup/regression claim:"
+        )
+        for r in result["reasons"]:
+            log(f"  - {r}")
+        return 3
+    inverse = any(
+        s and s["inverse"]
+        for s in (result["lane_scaling_old"], result["lane_scaling_new"])
+    )
+    if inverse:
+        log("compare: inverse lane scaling detected (see lane_scaling_*)")
+    for e in result["regressions"]:
+        log(
+            f"compare: regression {e['phase']}: {e['old']:g} -> "
+            f"{e['new']:g} ({e['delta_pct']:+.1f}%)"
+        )
+    log(
+        f"compare: VERDICT comparable — {len(result['deltas'])} shared "
+        f"phase(s), {len(result['regressions'])} regression(s), "
+        f"{len(result['improvements'])} improvement(s)"
+    )
+    if gate and (result["regressions"] or inverse):
+        return 2
+    return 0
+
+
+def main(argv=None):
+    """No args: run the full bench. ``--compare OLD.json [NEW.json]``:
+    offline record comparison (no jax import); ``--gate`` makes
+    regressions and inverse lane scaling exit nonzero for CI."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--compare", nargs="+", metavar="BENCH.json",
+        help="compare two BENCH records (or summarize one) instead of "
+        "running the bench; refuses cross-environment claims",
+    )
+    ap.add_argument(
+        "--gate", action="store_true",
+        help="with --compare: exit 2 on a regression or inverse lane "
+        "scaling (exit 3 stays: incomparable environments)",
+    )
+    args = ap.parse_args(argv)
+    if args.compare:
+        if len(args.compare) > 2:
+            ap.error("--compare takes one or two record files")
+        sys.exit(run_compare(args.compare, gate=args.gate))
+    run_bench()
+
+
+def run_bench():
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -2347,6 +2655,16 @@ def main():
     except Exception as e:  # pragma: no cover
         log(f"phase T slo skipped: {e}")
 
+    # schema-2 header: the environment fingerprint makes this round
+    # comparable (or provably incomparable) to any other round
+    env_fp = None
+    try:
+        from tpustream.obs.resources import collect_env_fingerprint
+
+        env_fp = collect_env_fingerprint().to_dict()
+    except Exception:
+        env_fp = None
+
     print(
         json.dumps(
             {
@@ -2354,7 +2672,12 @@ def main():
                 "value": round(rate),
                 "unit": "events/s",
                 "vs_baseline": round(rate / TARGET, 3),
+                "bench_schema": BENCH_SCHEMA,
+                "env": env_fp,
                 "detail": {
+                    # last stderr lines folded in, so the round's
+                    # narrative needs no separate bench_stderr.txt
+                    "stderr_tail": list(_LOG_TAIL),
                     "p99_alert_latency_ms_device": round(p99_dev, 2),
                     "p99_alert_latency_ms_tunnel": round(p99_tunnel, 2),
                     "alerts_emitted": total_alerts,

@@ -380,13 +380,36 @@ def test_tsm016_lanes_over_nonsplittable_source():
 
 
 def test_tsm016_lanes_exceeding_host_cores():
-    import os
+    from tpustream.obs import resources
 
-    lanes = (os.cpu_count() or 1) + 2
+    lanes = resources.usable_cores() + 2
     env = good_job(make_env(ingest_lanes=lanes))
     f = next(f for f in env.analyze() if f.code == "TSM016")
     assert f.severity == WARN
-    assert "core" in f.message
+    assert "usable core" in f.message
+
+
+def test_tsm016_respects_cgroup_quota(monkeypatch):
+    """The broken case the raw os.cpu_count() check missed: a 96-core
+    box under a 2-core cgroup quota must WARN at 4 lanes."""
+    from tpustream.obs import resources
+
+    monkeypatch.setattr(resources, "affinity_cores", lambda: 96)
+    monkeypatch.setattr(resources, "cgroup_quota_cores", lambda *a: 2.0)
+    env = good_job(make_env(ingest_lanes=4))
+    f = next(
+        f for f in env.analyze()
+        if f.code == "TSM016" and "usable core" in f.message
+    )
+    assert f.severity == WARN
+    assert "ingest_lanes=4" in f.message and "2 usable" in f.message
+    # clean twin: the same box with no quota has cores to spare
+    monkeypatch.setattr(resources, "cgroup_quota_cores", lambda *a: None)
+    env = good_job(make_env(ingest_lanes=4))
+    assert not [
+        f for f in env.analyze()
+        if f.code == "TSM016" and "usable core" in f.message
+    ]
 
 
 def test_tsm016_lanes_under_multihost(monkeypatch):
@@ -535,6 +558,38 @@ def test_tsm018_clean_configurations():
         trace_sample_rate=0.01,
     )))
     assert "TSM018" not in codes(env.analyze())
+
+
+def test_tsm019_dead_resource_sampler():
+    # resources on but no snapshot ticks to drive the sampler: ERROR
+    env = good_job(make_env(obs=ObsConfig(enabled=True, resources=True)))
+    f = next(f for f in env.analyze() if f.code == "TSM019")
+    assert f.severity == ERROR
+    assert "dead sampler" in f.message
+    # resources on with obs off entirely: same dead sampler
+    env = good_job(make_env(obs=ObsConfig(resources=True)))
+    assert any(
+        f.code == "TSM019" and f.severity == ERROR for f in env.analyze()
+    )
+
+
+def test_tsm019_lane_sweep_without_resources():
+    env = good_job(make_env(
+        ingest_lanes=2,
+        obs=ObsConfig(enabled=True, snapshot_interval_s=0.5),
+    ))
+    f = next(f for f in env.analyze() if f.code == "TSM019")
+    assert f.severity == INFO
+    assert "resource sampling off" in f.message
+
+
+def test_tsm019_clean_configuration():
+    env = good_job(make_env(
+        ingest_lanes=2,
+        obs=ObsConfig(enabled=True, resources=True,
+                      snapshot_interval_s=0.5),
+    ))
+    assert "TSM019" not in codes(env.analyze())
 
 
 def test_findings_sorted_errors_first():
@@ -740,7 +795,7 @@ def test_catalog_is_stable():
         "TSM001", "TSM002", "TSM003", "TSM004", "TSM005", "TSM006",
         "TSM007", "TSM008", "TSM009", "TSM010", "TSM011", "TSM012",
         "TSM013", "TSM014", "TSM015", "TSM016", "TSM017", "TSM018",
-        "TSM020", "TSM021",
+        "TSM019", "TSM020", "TSM021",
         "TSM022", "TSM023", "TSM024", "TSM025", "TSM030", "TSM031",
         "TSM032", "TSM033", "TSM034", "TSM040", "TSM041", "TSM042",
         "TSM043", "TSM044", "TSM045", "TSM046", "TSM047",

@@ -5,6 +5,7 @@ reverting to its RTT-corrupted form) are caught without the full bench.
 """
 
 import importlib.util
+import json
 import os
 
 import pytest
@@ -112,3 +113,31 @@ def test_tenant_slo_probe_tiny_mode(bench):
     assert d["flooder_budget_burn"] == pytest.approx(1.0)
     assert d["others_ok"] == 7
     assert d["tenants_json_scrape_ms"] >= 0
+
+
+def test_compare_smoke_same_env(bench, tmp_path):
+    """Schema-2 records minted on this host compare cleanly: the env
+    fingerprint matches itself, per-phase deltas come out, and the CI
+    gate stays green on an improvement."""
+    env = bench._resources_module().collect_env_fingerprint().to_dict()
+    rec = {
+        "bench": "tpu-stream-monitor",
+        "bench_schema": bench.BENCH_SCHEMA,
+        "env": env,
+        "value": 100.0,
+        "round_detail": {"sync_rows_per_s": 1000.0},
+    }
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(rec))
+    new = tmp_path / "new.json"
+    new.write_text(
+        json.dumps(dict(rec, round_detail={"sync_rows_per_s": 1500.0}))
+    )
+    loaded = bench.load_bench_record(str(old))
+    assert loaded["error"] is None
+    assert loaded["schema"] == bench.BENCH_SCHEMA
+    assert loaded["env"]["usable_cores"] >= 1
+    cmp = bench.compare_records(loaded, bench.load_bench_record(str(new)))
+    assert cmp["comparable"] is True
+    assert any(d["phase"] == "sync_rows_per_s" for d in cmp["deltas"])
+    assert bench.run_compare([str(old), str(new)], gate=True) == 0

@@ -259,7 +259,7 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 91
+    assert int(m.group(1)) == 109
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     # ... and the sharded-ingestion lane series

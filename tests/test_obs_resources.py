@@ -1,0 +1,501 @@
+"""Resource-plane observability (obs/resources.py + the bench compare
+gate): the ResourceSampler's /proc readers against canned fixture
+trees, usable-core derivation under cgroup quotas, environment
+fingerprint determinism and comparability, lane-PID attribution on a
+live 2-lane job, the lane_core_contention breadcrumb + built-in WARN
+health rule, the /env.json scrape endpoint, and ``bench.py --compare``
+verdicts (comparable deltas / incomparable fingerprints / inverse lane
+scaling under ``--gate``).
+
+The contract under test: resource numbers come only from /proc and
+sysfs (no new dependencies), every sample is delta-based so the
+gauges read as utilisations not raw tick counts, and a benchmark
+record without a matching environment fingerprint can never be
+compared silently."""
+
+import importlib.util
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tpustream import StreamExecutionEnvironment
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.obs.dump import _pid_stat_line
+from tpustream.obs.flightrecorder import FlightRecorder
+from tpustream.obs.health import AlertRule, HealthEngine
+from tpustream.obs.registry import MetricsRegistry
+from tpustream.obs.resources import (
+    EnvFingerprint,
+    ResourceSampler,
+    cgroup_quota_cores,
+    collect_env_fingerprint,
+    usable_cores,
+)
+from tpustream.obs.runtime import JobObs
+from tpustream.runtime.sources import ReplaySource
+from tpustream.runtime.supervisor import LANE_CONTENTION_HEALTH_RULE_NAME
+
+LINES = [
+    f"15634520{i:02d} 10.8.22.{i % 5} cpu{i % 3} {40 + (i * 31) % 55}.5"
+    for i in range(72)
+]
+
+
+def _write(root, rel, body):
+    path = os.path.join(str(root), rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(body)
+
+
+def _series(reg):
+    return reg.snapshot()["series"]
+
+
+def _value(series, name, **labels):
+    for s in series:
+        if s["name"] == name and all(
+            s["labels"].get(k) == v for k, v in labels.items()
+        ):
+            return s["value"]
+    raise AssertionError(f"no series {name} {labels}")
+
+
+# -- cgroup quota / usable cores ------------------------------------------
+
+
+def test_cgroup_v2_quota(tmp_path):
+    _write(tmp_path, "cpu.max", "150000 100000\n")
+    assert cgroup_quota_cores(str(tmp_path)) == pytest.approx(1.5)
+
+
+def test_cgroup_v2_unlimited(tmp_path):
+    _write(tmp_path, "cpu.max", "max 100000\n")
+    assert cgroup_quota_cores(str(tmp_path)) is None
+
+
+def test_cgroup_v1_quota(tmp_path):
+    _write(tmp_path, "cpu/cpu.cfs_quota_us", "200000\n")
+    _write(tmp_path, "cpu/cpu.cfs_period_us", "100000\n")
+    assert cgroup_quota_cores(str(tmp_path)) == pytest.approx(2.0)
+
+
+def test_cgroup_v1_unlimited(tmp_path):
+    _write(tmp_path, "cpu/cpu.cfs_quota_us", "-1\n")
+    _write(tmp_path, "cpu/cpu.cfs_period_us", "100000\n")
+    assert cgroup_quota_cores(str(tmp_path)) is None
+
+
+def test_usable_cores_capped_by_quota(tmp_path):
+    # a 0.5-core quota must floor to 1 usable core, never 0
+    _write(tmp_path, "cpu.max", "50000 100000\n")
+    assert usable_cores(str(tmp_path)) == 1
+    # a fractional quota rounds up: 2.5 cores of quota -> 3 usable at
+    # most, then capped by the scheduler affinity of this process
+    _write(tmp_path, "cpu.max", "250000 100000\n")
+    assert 1 <= usable_cores(str(tmp_path)) <= 3
+
+
+def test_usable_cores_no_cgroup(tmp_path):
+    # empty sysfs root: affinity alone decides
+    assert usable_cores(str(tmp_path)) >= 1
+
+
+# -- environment fingerprint ----------------------------------------------
+
+
+def test_fingerprint_deterministic_and_roundtrips():
+    a = collect_env_fingerprint()
+    b = collect_env_fingerprint()
+    assert a == b
+    assert EnvFingerprint.from_dict(a.to_dict()) == a
+    assert a.comparability(b) == []
+    assert str(a.usable_cores) in a.compact()
+
+
+def test_fingerprint_comparability_reasons():
+    a = collect_env_fingerprint()
+    d = a.to_dict()
+    d["usable_cores"] = a.usable_cores + 8
+    d["backend"] = "tpu" if a.backend != "tpu" else "cpu"
+    other = EnvFingerprint.from_dict(d)
+    reasons = a.comparability(other)
+    assert len(reasons) >= 2
+    assert any("usable cores" in r for r in reasons)
+    assert any("backend" in r for r in reasons)
+    # hostname differences alone do NOT make records incomparable
+    d2 = a.to_dict()
+    d2["host"] = "ffffffffffff"
+    assert a.comparability(EnvFingerprint.from_dict(d2)) == []
+
+
+# -- ResourceSampler against a canned /proc tree --------------------------
+
+
+@pytest.fixture
+def canned(tmp_path):
+    """A fake /proc with one deterministic host + process + two lane
+    workers pinned to core 0; advancing it one tick moves every clock
+    by a known amount."""
+    proc = tmp_path / "proc"
+
+    def tick0():
+        _write(proc, "stat", "cpu  100 0 100 700 100 0 0 0\n")
+        _write(proc, "self/statm", "5000 2500 300 1 0 800 0\n")
+        _write(
+            proc,
+            "self/status",
+            "voluntary_ctxt_switches:\t10\n"
+            "nonvoluntary_ctxt_switches:\t3\n",
+        )
+        _write(proc, "111/stat", _pid_stat_line(111, "tsm-lane0", 50, 50, 0))
+        _write(proc, "222/stat", _pid_stat_line(222, "tsm-lane1", 60, 40, 0))
+
+    def tick1():
+        # +200 busy / +800 total host ticks -> util 0.25; lane0 +60
+        # ticks over 1 injected second -> util 0.6; lane1 +40 -> 0.4
+        _write(proc, "stat", "cpu  250 0 150 1250 150 0 0 0\n")
+        _write(
+            proc,
+            "self/status",
+            "voluntary_ctxt_switches:\t15\n"
+            "nonvoluntary_ctxt_switches:\t5\n",
+        )
+        _write(proc, "111/stat", _pid_stat_line(111, "tsm-lane0", 90, 70, 0))
+        _write(proc, "222/stat", _pid_stat_line(222, "tsm-lane1", 80, 60, 0))
+
+    reg = MetricsRegistry()
+    flight = FlightRecorder(256)
+    clock = iter((0.0, 1.0, 2.0, 3.0))
+    sampler = ResourceSampler(
+        reg.group(job="t"),
+        flight=flight,
+        proc_root=str(proc),
+        clock=lambda: next(clock),
+        page_size=4096,
+        ticks_per_s=100,
+    )
+    pids = {0: 111, 1: 222}
+    sampler.attach_lanes(lambda: pids)
+    return sampler, reg, flight, tick0, tick1, pids
+
+
+def test_sampler_minted_series(canned):
+    sampler, reg, flight, tick0, tick1, _ = canned
+    tick0()
+    sampler.sample()
+    tick1()
+    sampler.sample()
+    series = _series(reg)
+    assert _value(series, "host_cpu_util") == pytest.approx(0.25)
+    assert _value(series, "lane_cpu_util", lane="0") == pytest.approx(0.6)
+    assert _value(series, "lane_cpu_util", lane="1") == pytest.approx(0.4)
+    assert _value(series, "lane_core", lane="0") == 0
+    assert _value(series, "lane_core", lane="1") == 0
+    assert _value(series, "process_rss_bytes") == 2500 * 4096
+    assert _value(series, "ctx_switches_total", kind="voluntary") == 15
+    assert _value(series, "ctx_switches_total", kind="involuntary") == 5
+    assert sampler.samples == 2
+
+
+def test_sampler_contention_breadcrumbs(canned):
+    sampler, reg, flight, tick0, tick1, _ = canned
+    tick0()
+    sampler.sample()
+    tick1()
+    sampler.sample()
+    # both lanes busy on core 0 AND their summed util ~1.0: the same
+    # tick fires the same_core reason and the pinned reason
+    series = _series(reg)
+    assert _value(series, "lane_core_contention_total") >= 2
+    crumbs = [
+        e for e in flight.events() if e["kind"] == "lane_core_contention"
+    ]
+    assert {c["reason"] for c in crumbs} == {"same_core", "pinned"}
+    # breadcrumbs are one-shot per (reason, core); the counter keeps
+    # climbing on a repeat observation but the flight ring does not
+    before = len(crumbs)
+    tick1()
+    sampler.sample()
+    crumbs = [
+        e for e in flight.events() if e["kind"] == "lane_core_contention"
+    ]
+    assert len(crumbs) == before
+
+
+def test_sampler_vanished_lane_parked(canned):
+    sampler, reg, flight, tick0, tick1, pids = canned
+    tick0()
+    sampler.sample()
+    tick1()
+    sampler.sample()
+    pids.pop(1)
+    sampler.sample()
+    series = _series(reg)
+    assert _value(series, "lane_cpu_util", lane="1") == 0.0
+    assert _value(series, "lane_core", lane="1") == -1
+    assert 1 not in sampler.last_lane_util
+
+
+def test_sampler_survives_empty_proc(tmp_path):
+    reg = MetricsRegistry()
+    sampler = ResourceSampler(
+        reg.group(job="t"), proc_root=str(tmp_path / "nope")
+    )
+    sampler.attach_lanes(lambda: {0: 999999})
+    sampler.sample()
+    sampler.sample()
+    assert sampler.samples == 2
+
+
+def test_contention_trips_health_rule(canned):
+    sampler, reg, flight, tick0, tick1, _ = canned
+    tick0()
+    sampler.sample()
+    tick1()
+    sampler.sample()
+    engine = HealthEngine(
+        [
+            AlertRule(
+                name=LANE_CONTENTION_HEALTH_RULE_NAME,
+                metric="lane_core_contention_total",
+                op=">",
+                value=0.0,
+                severity="warn",
+                agg="sum",
+            )
+        ]
+    )
+    state = engine.evaluate(_series(reg), now_s=1.0)
+    assert state["level"] == "warn"
+    by_name = {r["rule"]: r for r in state["rules"]}
+    assert by_name[LANE_CONTENTION_HEALTH_RULE_NAME]["level"] == "warn"
+
+
+# -- live job: lane attribution + env embedding ---------------------------
+
+
+def run_job(lines, **over):
+    from tpustream.jobs.chapter2_max import build
+
+    over.setdefault("batch_size", 4)
+    cfg = StreamConfig(**over)
+    env = StreamExecutionEnvironment(cfg)
+    handle = build(env, env.add_source(ReplaySource(lines))).collect()
+    result = env.execute("obs-resources-test")
+    return env, handle.items, result
+
+
+def test_live_two_lane_job_attribution():
+    env, items, result = run_job(
+        LINES,
+        ingest_lanes=2,
+        obs=ObsConfig(
+            enabled=True, resources=True, snapshot_interval_s=0.01
+        ),
+    )
+    assert len(items) > 0
+    snap = result.metrics.obs_snapshot()
+    names = {
+        (s["name"], s["labels"].get("lane"))
+        for s in snap["metrics"]["series"]
+    }
+    # the sampler ran and attributed at least one lane worker by PID
+    assert ("host_cpu_util", None) in names
+    assert ("process_rss_bytes", None) in names
+    lanes_seen = {l for n, l in names if n == "lane_core" and l}
+    assert lanes_seen, "no lane_core series minted for any lane worker"
+    # the environment fingerprint rides in every snapshot...
+    assert snap["meta"]["env"]["usable_cores"] >= 1
+    # ...and the built-in contention WARN rule was auto-installed
+    rules = [
+        getattr(r, "name", None) or r.get("name")
+        for r in (env.config.obs.health_rules or ())
+    ]
+    assert LANE_CONTENTION_HEALTH_RULE_NAME in rules
+
+
+def test_env_json_scrape_roundtrip():
+    jo = JobObs(
+        ObsConfig(enabled=True, serve_port=0), job_name="env-scrape"
+    )
+    try:
+        with urllib.request.urlopen(
+            jo.server.url + "/env.json", timeout=5
+        ) as resp:
+            served = json.loads(resp.read().decode())
+        assert served == jo.env_snapshot()
+        assert served["schema"] >= 1
+        assert jo.env_compact()  # non-empty summary string
+    finally:
+        jo.close(dump=False)
+
+
+def test_null_obs_has_env_surface():
+    from tpustream.obs.runtime import NULL_JOB_OBS
+
+    assert NULL_JOB_OBS.env_snapshot() is None
+    assert NULL_JOB_OBS.env_compact() is None
+    assert NULL_JOB_OBS.resources is None
+    assert NULL_JOB_OBS.env_fingerprint is None
+
+
+# -- bench --compare verdicts ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(
+        os.path.dirname(__file__), os.pardir, "bench.py"
+    )
+    spec = importlib.util.spec_from_file_location("bench_cmp", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _record(env, headline, sweep=None, extra=None):
+    detail = dict(extra or {})
+    if sweep is not None:
+        detail["ingest_lane_sweep"] = {
+            "results": [
+                {"lanes": l, "lines_per_s": r} for l, r in sweep
+            ]
+        }
+    return {
+        "bench": "tpu-stream-monitor",
+        "bench_schema": 2,
+        "env": env,
+        "value": headline,
+        "round_detail": detail,
+    }
+
+
+def _dump(tmp_path, name, record):
+    path = tmp_path / name
+    path.write_text(json.dumps(record))
+    return str(path)
+
+
+def test_compare_same_fingerprint_deltas(tmp_path, bench):
+    env = collect_env_fingerprint().to_dict()
+    old = _dump(
+        tmp_path, "old.json",
+        _record(env, 1000.0, extra={"parse_ms": 10.0}),
+    )
+    new = _dump(
+        tmp_path, "new.json",
+        _record(env, 1200.0, extra={"parse_ms": 8.0}),
+    )
+    cmp = bench.compare_records(
+        bench.load_bench_record(old), bench.load_bench_record(new)
+    )
+    assert cmp["comparable"] is True
+    deltas = {d["phase"]: d for d in cmp["deltas"]}
+    assert deltas["headline"]["delta_pct"] == pytest.approx(20.0)
+    assert deltas["parse_ms"]["delta_pct"] == pytest.approx(-20.0)
+    # parse_ms is directional (lower is better) and moved >=10%: an
+    # improvement; the bare headline has no known direction
+    assert [e["phase"] for e in cmp["improvements"]] == ["parse_ms"]
+    assert not cmp["regressions"]
+    assert bench.run_compare([old, new], gate=False) == 0
+    assert bench.run_compare([old, new], gate=True) == 0
+
+
+def test_compare_gate_fails_on_regression(tmp_path, bench):
+    env = collect_env_fingerprint().to_dict()
+    old = _dump(
+        tmp_path, "old.json",
+        _record(env, 1.0, extra={"parse_lines_per_s": 1000.0}),
+    )
+    new = _dump(
+        tmp_path, "new.json",
+        _record(env, 1.0, extra={"parse_lines_per_s": 700.0}),
+    )
+    assert bench.run_compare([old, new], gate=False) == 0
+    assert bench.run_compare([old, new], gate=True) == 2
+
+
+def test_compare_mismatched_fingerprints_incomparable(tmp_path, bench):
+    env_a = collect_env_fingerprint().to_dict()
+    env_b = dict(env_a, usable_cores=env_a["usable_cores"] + 8,
+                 backend="tpu" if env_a["backend"] != "tpu" else "cpu")
+    old = _dump(tmp_path, "old.json", _record(env_a, 1000.0))
+    new = _dump(tmp_path, "new.json", _record(env_b, 2000.0))
+    cmp = bench.compare_records(
+        bench.load_bench_record(old), bench.load_bench_record(new)
+    )
+    assert cmp["comparable"] is False
+    assert cmp["reasons"]
+    assert bench.run_compare([old, new], gate=False) == 3
+
+
+def test_compare_pre_schema_record_incomparable(tmp_path, bench):
+    env = collect_env_fingerprint().to_dict()
+    legacy = _record(None, 1000.0)
+    legacy.pop("env")
+    legacy.pop("bench_schema")
+    old = _dump(tmp_path, "old.json", legacy)
+    new = _dump(tmp_path, "new.json", _record(env, 1000.0))
+    assert bench.run_compare([old, new], gate=False) == 3
+
+
+def test_compare_gate_flags_inverse_lane_scaling(tmp_path, bench):
+    env = collect_env_fingerprint().to_dict()
+    # the r07 pathology: lanes added, throughput roughly halved
+    sweep = [(1, 2196871.0), (2, 1139944.0), (4, 592194.0)]
+    rec = bench.load_bench_record(
+        _dump(tmp_path, "r.json", _record(env, 592194.0, sweep=sweep))
+    )
+    scaling = bench.check_lane_scaling(rec["lane_sweep"])
+    assert scaling["inverse"] is True
+    assert scaling["top_over_base"] < 0.5
+    path = _dump(tmp_path, "single.json", _record(env, 1.0, sweep=sweep))
+    assert bench.run_compare([path], gate=False) == 0
+    assert bench.run_compare([path], gate=True) == 2
+    healthy = [(1, 1000.0), (2, 1900.0)]
+    path2 = _dump(
+        tmp_path, "healthy.json", _record(env, 1.0, sweep=healthy)
+    )
+    assert bench.run_compare([path2], gate=True) == 0
+
+
+def test_compare_round_wrapper_tail(tmp_path, bench):
+    # r06/r07-style wrapper: parsed is null but the stderr tail still
+    # carries the one-line BENCH record
+    env = collect_env_fingerprint().to_dict()
+    inner = _record(env, 500.0)
+    wrapper = {
+        "n": 6,
+        "cmd": "python bench.py",
+        "rc": 0,
+        "tail": "some noise\nBENCH " + json.dumps(inner),
+        "parsed": None,
+    }
+    rec = bench.load_bench_record(_dump(tmp_path, "w.json", wrapper))
+    assert rec["error"] is None
+    assert rec["env"]["usable_cores"] == env["usable_cores"]
+    assert rec["phases"]["headline"] == 500.0
+    # r05-style wrapper with a truncated tail: unusable, hence
+    # incomparable rather than silently zero-delta
+    wrapper["tail"] = "some noise only"
+    rec = bench.load_bench_record(_dump(tmp_path, "w2.json", wrapper))
+    assert rec["error"]
+    good = _dump(tmp_path, "good.json", _record(env, 500.0))
+    assert bench.run_compare(
+        [str(tmp_path / "w2.json"), good], gate=False
+    ) == 3
+
+
+def test_compare_cli_entrypoint(tmp_path, bench):
+    env = collect_env_fingerprint().to_dict()
+    old = _dump(tmp_path, "old.json", _record(env, 100.0))
+    new = _dump(tmp_path, "new.json", _record(env, 101.0))
+    with pytest.raises(SystemExit) as e:
+        bench.main(["--compare", old, new])
+    assert e.value.code == 0
+    with pytest.raises(SystemExit) as e:
+        bench.main(["--compare", str(tmp_path / "missing.json")])
+    assert e.value.code == 1

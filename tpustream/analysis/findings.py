@@ -246,6 +246,20 @@ CATALOG = {
             "and keep the rate in (0, 1] (e.g. 0.01 for 1%)",
         ),
         Rule(
+            "TSM019", ERROR, "resource sampling misconfigured for this job",
+            "the obs resource plane (ObsConfig.resources) reads /proc "
+            "only at Snapshotter ticks: with obs disabled or "
+            "snapshot_interval_s == 0 the sampler never runs and every "
+            "host/lane series stays empty while the config claims host "
+            "telemetry is on. Conversely, a multi-lane ingest job with "
+            "resource sampling off cannot attribute its lane scaling — "
+            "bench round r07's inverse scaling (more lanes, less "
+            "throughput, one usable core) was only diagnosable by hand.",
+            "set ObsConfig.enabled = True and snapshot_interval_s > 0 "
+            "alongside resources = True; turn resources on whenever "
+            "ingest_lanes > 1",
+        ),
+        Rule(
             "TSM020", WARN, "nondeterministic call in a user function",
             "time/random/datetime/uuid calls make replay diverge: a "
             "supervised restart reprocesses records from the last "

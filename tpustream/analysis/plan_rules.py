@@ -250,14 +250,17 @@ def check_ingest_lane_misconfig(ctx) -> Iterable[Finding]:
                 "is not line-splittable: the runtime forces single-lane "
                 "ingestion and the extra lanes never run",
             )
-    import os as _os
+    # usable cores, not os.cpu_count(): a 96-core box under a 2-core
+    # cgroup quota is a 2-core host (shared with the env fingerprint)
+    from ..obs import resources as _res
 
-    host_cores = _os.cpu_count() or 1
+    host_cores = _res.usable_cores()
     if lanes > host_cores:
         yield make_finding(
             "TSM016", None,
             f"ingest_lanes={lanes} exceeds this host's {host_cores} "
-            "core(s): lane workers contend for cores instead of "
+            "usable core(s) (scheduler affinity capped by the cgroup "
+            "cpu quota): lane workers contend for cores instead of "
             "parallelising the parse",
             severity=WARN,
         )
@@ -663,6 +666,41 @@ def check_trace_sampling_carrier(ctx) -> Iterable[Finding]:
             "lineage rides the latency-marker side-channel, so no "
             "marker stamper means no trace is ever minted — "
             "/trace.json will carry no record lineage",
+        )
+
+
+@rule
+def check_resource_sampling(ctx) -> Iterable[Finding]:
+    """TSM019: resource-plane sampling that cannot run, or a lane
+    sweep nothing can interpret.
+
+    The ResourceSampler (obs/resources.py) only reads /proc at
+    Snapshotter ticks, so ``resources=True`` with obs disabled or a
+    zero snapshot interval is a dead sampler — every resource series
+    stays empty while the config claims host telemetry is on (ERROR).
+    The inverse shape is quieter but cost bench round r07 a day:
+    multiple ingest lanes with no resource sampling means lane scaling
+    (or inverse scaling) cannot be attributed to cores vs contention
+    (INFO)."""
+    obs = ctx.cfg.obs
+    enabled = bool(getattr(obs, "resources", False))
+    interval = float(getattr(obs, "snapshot_interval_s", 0.0) or 0.0)
+    lanes = getattr(ctx.cfg, "ingest_lanes", 1)
+    if enabled and (not obs.enabled or interval <= 0):
+        yield make_finding(
+            "TSM019", None,
+            f"obs.resources=True with obs.enabled={obs.enabled} and "
+            f"snapshot_interval_s={interval:g}: the resource sampler "
+            "only runs at snapshot ticks, so no host/lane series is "
+            "ever sampled (dead sampler)",
+        )
+    if lanes > 1 and not (enabled and obs.enabled):
+        yield make_finding(
+            "TSM019", None,
+            f"ingest_lanes={lanes} with resource sampling off: without "
+            "per-lane CPU/core series a lane sweep's scaling cannot be "
+            "attributed to cores vs contention (set obs.resources=True)",
+            severity=INFO,
         )
 
 

@@ -38,6 +38,20 @@ class ObsConfig:
     snapshot_path: str = ""           # optional JSONL file the periodic
                                       # snapshotter appends to
 
+    # -- resource plane (obs/resources.py) ----------------------------------
+    resources: bool = False
+    # True: a ResourceSampler rides the snapshotter's pre-hook and reads
+    # /proc at every snapshot tick — host-wide CPU util, process RSS and
+    # context switches, per-ingest-lane-worker CPU time and core
+    # placement — minting host_cpu_util / lane_cpu_util{lane} /
+    # lane_core{lane} / process_rss_bytes / ctx_switches_total{kind},
+    # plus a lane_core_contention detector (two busy lanes on one core,
+    # or a multi-lane plane pinned at ~1 core of total CPU -> flight
+    # breadcrumb + lane_core_contention_total + built-in WARN health
+    # rule). Requires snapshot_interval_s > 0 to sample during the run
+    # (analyzer rule TSM019 flags the dead-sampler combination). Reads
+    # Linux /proc only; elsewhere samples degrade to no-ops.
+
     # -- end-to-end latency markers (obs/latency.py) ------------------------
     latency_marker_interval_ms: float = 0.0
     # > 0: the source stamps a LatencyMarker into the batch stream every

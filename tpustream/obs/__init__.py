@@ -53,6 +53,12 @@ the TPU-runtime equivalent:
   per-shard ``hbm_state_bytes``, per-component state bytes, key-table
   capacity/occupancy/load-factor, and key-cardinality / hot-key-skew
   gauges.
+* :mod:`tpustream.obs.resources` — the resource plane: a ``/proc``
+  sampler riding the snapshot cadence (host CPU util, process RSS and
+  context switches, per-ingest-lane CPU time and core placement, a
+  lane-core contention detector) plus the ``EnvFingerprint`` every
+  snapshot and BENCH record carries (usable cores = affinity ∩ cgroup
+  quota, NUMA nodes, jax backend/devices, hostname hash).
 * :mod:`tpustream.obs.serve` — opt-in live scrape endpoint
   (``ObsConfig.serve_port``): ``/metrics``, ``/healthz``,
   ``/snapshot.json`` on a background daemon thread.
@@ -116,3 +122,9 @@ from .slo import (  # noqa: F401
     slo_rule_names,
 )
 from .catalog import series_is_known, unknown_series  # noqa: F401
+from .resources import (  # noqa: F401
+    EnvFingerprint,
+    ResourceSampler,
+    collect_env_fingerprint,
+    usable_cores,
+)

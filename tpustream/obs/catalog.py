@@ -69,6 +69,9 @@ KNOWN_SERIES = frozenset({
     "trace_spans_dropped_total", "record_traces_sampled_total",
     # analyzer
     "analysis_findings_total",
+    # resource plane (obs/resources.py), sampled at snapshot ticks
+    "host_cpu_util", "lane_cpu_util", "lane_core", "process_rss_bytes",
+    "ctx_switches_total", "lane_core_contention_total",
     # multi-tenant fleet (docs/multitenancy.md)
     "tenant_count", "tenant_records_total", "tenant_quota_exceeded_total",
     "tenant_emitted_total", "tenant_dead_letter_total", "tenant_error_rate",

@@ -582,6 +582,167 @@ def _selftest_trace() -> list:
     return checks
 
 
+def _pid_stat_line(pid: int, comm: str, utime: int, stime: int,
+                   core: int) -> str:
+    """A /proc/<pid>/stat line with the comm parens intact: utime and
+    stime are fields 14/15 and processor is field 39 (1-indexed)."""
+    fields = ["0"] * 37
+    fields[0] = "R"
+    fields[11] = str(utime)
+    fields[12] = str(stime)
+    fields[36] = str(core)
+    return f"{pid} ({comm}) " + " ".join(fields) + "\n"
+
+
+def _selftest_resources() -> list:
+    """Resource-plane checks (obs/resources.py): fingerprint
+    determinism and round-trip, cgroup-quota core capping, and a
+    ResourceSampler run over a canned /proc tree — host util deltas,
+    RSS, context switches, per-lane CPU/core attribution, and both
+    contention shapes (same core, plane pinned at ~1 core)."""
+    import os as _os
+    import tempfile
+
+    from .flightrecorder import FlightRecorder
+    from .registry import MetricsRegistry
+    from .resources import (
+        EnvFingerprint,
+        ResourceSampler,
+        cgroup_quota_cores,
+        collect_env_fingerprint,
+        usable_cores,
+    )
+
+    fp1 = collect_env_fingerprint()
+    fp2 = collect_env_fingerprint()
+    roundtrip = EnvFingerprint.from_dict(fp1.to_dict())
+    mismatched = EnvFingerprint.from_dict(
+        dict(fp1.to_dict(), usable_cores=fp1.usable_cores + 7,
+             backend="antique-abacus")
+    )
+
+    checks = [
+        ("env fingerprint is deterministic", fp1 == fp2),
+        ("env fingerprint round-trips through its dict",
+         roundtrip == fp1),
+        ("identical fingerprints are comparable",
+         fp1.comparability(fp2) == []),
+        ("core/backend mismatch yields incomparability reasons",
+         len(fp1.comparability(mismatched)) >= 2),
+        ("compact form carries cores and backend",
+         f"@{fp1.usable_cores}c" in fp1.compact()
+         and fp1.backend in fp1.compact()),
+    ]
+
+    with tempfile.TemporaryDirectory() as td:
+        proc = _os.path.join(td, "proc")
+        cg = _os.path.join(td, "cgroup")
+
+        def w(root, rel, body):
+            p = _os.path.join(root, rel)
+            _os.makedirs(_os.path.dirname(p), exist_ok=True)
+            with open(p, "w") as f:
+                f.write(body)
+
+        # a half-core cgroup v2 quota must cap usable cores at 1
+        w(cg, "cpu.max", "50000 100000\n")
+        checks.append(
+            ("cgroup v2 quota parses to cores",
+             cgroup_quota_cores(cg) == 0.5)
+        )
+        checks.append(
+            ("quota caps usable cores", usable_cores(sys_root=cg) == 1)
+        )
+
+        # tick 1 of the canned host: 2 busy-equivalent of 10 total
+        w(proc, "stat", "cpu 100 0 100 700 100 0 0 0\n")
+        w(proc, "self/statm", "5000 2500 300 1 0 1200 0\n")
+        w(proc, "self/status",
+          "Name:\tselftest\n"
+          "voluntary_ctxt_switches:\t10\n"
+          "nonvoluntary_ctxt_switches:\t3\n")
+        w(proc, "111/stat", _pid_stat_line(111, "tsm-lane0", 50, 50, 0))
+        w(proc, "222/stat", _pid_stat_line(222, "tsm-lane1", 40, 60, 0))
+
+        reg = MetricsRegistry()
+        g = reg.group(job="selftest")
+        flight = FlightRecorder(capacity=16)
+        clock = iter((0.0, 1.0, 2.0))
+        sampler = ResourceSampler(
+            g, flight=flight, proc_root=proc,
+            clock=lambda: next(clock), page_size=4096, ticks_per_s=100,
+        )
+        sampler.attach_lanes(lambda: {0: 111, 1: 222})
+        sampler.sample()
+
+        # tick 2, one second later: host burned 200 of 800 ticks; lane
+        # 0 burned 60 ticks (0.6 cores), lane 1 burned 40 (0.4) — both
+        # on core 0, summing inside the pinned-at-one-core band
+        w(proc, "stat", "cpu 200 0 200 1300 100 0 0 0\n")
+        w(proc, "self/statm", "5000 2500 300 1 0 1200 0\n")
+        w(proc, "self/status",
+          "Name:\tselftest\n"
+          "voluntary_ctxt_switches:\t15\n"
+          "nonvoluntary_ctxt_switches:\t5\n")
+        w(proc, "111/stat", _pid_stat_line(111, "tsm-lane0", 90, 70, 0))
+        w(proc, "222/stat", _pid_stat_line(222, "tsm-lane1", 60, 80, 0))
+        sampler.sample()
+
+        series = {
+            (s["name"], s["labels"].get("lane", ""),
+             s["labels"].get("kind", "")): s["value"]
+            for s in reg.snapshot()["series"]
+            if "value" in s
+        }
+        prom = reg.to_prometheus_text()
+        contention_kinds = [
+            e.get("reason") for e in flight.events()
+            if e["kind"] == "lane_core_contention"
+        ]
+        checks.extend([
+            ("host util follows /proc/stat deltas",
+             abs(series.get(("host_cpu_util", "", ""), 0.0) - 0.25) < 1e-9),
+            ("process rss follows statm pages",
+             series.get(("process_rss_bytes", "", "")) == 2500 * 4096),
+            ("ctx switch counters replay the kernel totals",
+             series.get(("ctx_switches_total", "", "voluntary")) == 15
+             and series.get(("ctx_switches_total", "", "involuntary")) == 5),
+            ("per-lane cpu util attributes the burn",
+             abs(series.get(("lane_cpu_util", "0", ""), 0.0) - 0.6) < 1e-9
+             and abs(series.get(("lane_cpu_util", "1", ""), 0.0) - 0.4)
+             < 1e-9),
+            ("lane core placement lands",
+             series.get(("lane_core", "0", "")) == 0
+             and series.get(("lane_core", "1", "")) == 0),
+            ("same-core contention leaves a breadcrumb",
+             "same_core" in contention_kinds),
+            ("pinned-at-one-core contention leaves a breadcrumb",
+             "pinned" in contention_kinds),
+            ("contention counter feeds the health rule",
+             series.get(("lane_core_contention_total", "", ""), 0) >= 2),
+            ("prometheus carries the resource series",
+             'host_cpu_util{job="selftest"}' in prom
+             and 'lane_cpu_util{job="selftest",lane="0"}' in prom),
+        ])
+
+        # tick 3: lane 1 vanished — its util zeroes, its core parks
+        sampler.attach_lanes(lambda: {0: 111})
+        w(proc, "stat", "cpu 300 0 300 1900 100 0 0 0\n")
+        w(proc, "111/stat", _pid_stat_line(111, "tsm-lane0", 120, 90, 1))
+        sampler.sample()
+        series3 = {
+            (s["name"], s["labels"].get("lane", "")): s["value"]
+            for s in reg.snapshot()["series"]
+            if "value" in s
+        }
+        checks.append(
+            ("vanished lane zeroes its series",
+             series3.get(("lane_cpu_util", "1")) == 0.0
+             and series3.get(("lane_core", "1")) == -1)
+        )
+    return checks
+
+
 def _selftest() -> int:
     """CI smoke mode: a canned registry (hostile labels included) runs
     through snapshot -> render -> Prometheus exposition -> health
@@ -747,6 +908,10 @@ def _selftest() -> int:
 
     from .serve import MetricsServer
 
+    from .resources import collect_env_fingerprint as _collect_env
+
+    _env_view = _collect_env().to_dict()
+
     class _Provider:
         health = engine
 
@@ -755,6 +920,9 @@ def _selftest() -> int:
 
         def snapshot(self):
             return job_snapshot(reg, meta={"job": "selftest"})
+
+        def env_snapshot(self):
+            return _env_view
 
     srv = MetricsServer(_Provider(), port=0)
     srv.start()
@@ -765,6 +933,11 @@ def _selftest() -> int:
         served_snap = _json.loads(
             urllib.request.urlopen(
                 srv.url + "/snapshot.json", timeout=5
+            ).read().decode("utf-8")
+        )
+        served_env = _json.loads(
+            urllib.request.urlopen(
+                srv.url + "/env.json", timeout=5
             ).read().decode("utf-8")
         )
         try:
@@ -794,6 +967,8 @@ def _selftest() -> int:
          any(s["name"] == "records_in"
              for s in served_snap["metrics"]["series"])),
         ("healthz reflects the crit rule", hz_code == 503),
+        ("serve env.json round-trips the fingerprint",
+         served_env == _env_view),
         ("render names the counter", "records_in" in text),
         ("render names the histogram", "e2e_latency_ms" in text),
         ("render names the checkpoint cost histograms",
@@ -918,6 +1093,7 @@ def _selftest() -> int:
     checks.extend(_selftest_timeseries())
     checks.extend(_selftest_profile())
     checks.extend(_selftest_trace())
+    checks.extend(_selftest_resources())
     failed = [name for name, ok in checks if not ok]
     for name, ok in checks:
         sys.stdout.write(f"{'ok' if ok else 'FAIL'}: {name}\n")
@@ -979,6 +1155,12 @@ def main(argv=None) -> int:
         "against the snapshot's series",
     )
     ap.add_argument(
+        "--env",
+        action="store_true",
+        help="show the environment fingerprint: a snapshot's embedded "
+        "one when a path is given, the LIVE host's otherwise",
+    )
+    ap.add_argument(
         "--selftest",
         action="store_true",
         help="run the built-in smoke test (no snapshot needed)",
@@ -986,9 +1168,29 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
+    if args.env and not args.path:
+        from .resources import collect_env_fingerprint
+
+        sys.stdout.write(
+            json.dumps(collect_env_fingerprint().to_dict(),
+                       indent=2, sort_keys=True) + "\n"
+        )
+        return 0
     if not args.path:
-        ap.error("path is required (or use --selftest)")
+        ap.error("path is required (or use --selftest / --env)")
     snap = _load(args.path, args.index)
+    if args.env:
+        env = snap.get("meta", {}).get("env") or snap.get("env")
+        if not env:
+            sys.stdout.write(
+                "no environment fingerprint in this snapshot "
+                "(pre-resource-plane capture)\n"
+            )
+            return 1
+        sys.stdout.write(
+            json.dumps(env, indent=2, sort_keys=True) + "\n"
+        )
+        return 0
     if args.rules:
         from .health import HealthEngine
 

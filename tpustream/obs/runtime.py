@@ -129,6 +129,19 @@ class JobObs:
         self.snapshotter.profiler = self.profiler
         self._op_names: dict = {}
 
+        # environment fingerprint (obs/resources.py): what host/device
+        # this job actually ran on, embedded in every snapshot's meta
+        # and served at /env.json — collection is a handful of file
+        # reads and never imports jax
+        self.env_fingerprint = None
+        try:
+            from .resources import collect_env_fingerprint
+
+            self.env_fingerprint = collect_env_fingerprint()
+            self.snapshotter.meta["env"] = self.env_fingerprint.to_dict()
+        except Exception:
+            self.env_fingerprint = None
+
         # crash-dump flight recorder (obs/flightrecorder.py); a
         # supervised job passes ONE recorder through every restart
         # attempt so the postmortem ring spans failure -> restart ->
@@ -151,6 +164,17 @@ class JobObs:
             )
         if self.profiler is not None:
             self.profiler.flight = self.flight
+
+        # resource plane (obs/resources.py): /proc sampler riding the
+        # snapshotter's pre-hook so host/lane series advance at exactly
+        # the snapshot cadence; the executor attaches lane PIDs once the
+        # ingest plane is up
+        self.resources = None
+        if getattr(cfg, "resources", False):
+            from .resources import ResourceSampler
+
+            self.resources = ResourceSampler(self.group, flight=self.flight)
+            self.snapshotter.pre_hooks.append(self.resources.sample)
 
         # self-monitoring health engine (obs/health.py); rule state
         # gauges land in the job group so they are ordinary series
@@ -218,8 +242,24 @@ class JobObs:
     def maybe_snapshot(self):
         return self.snapshotter.maybe_snapshot()
 
+    def env_snapshot(self) -> Optional[dict]:
+        """The environment fingerprint dict (the /env.json body), or
+        None when collection failed (the serve layer answers 404)."""
+        if self.env_fingerprint is None:
+            return None
+        return self.env_fingerprint.to_dict()
+
+    def env_compact(self) -> Optional[str]:
+        """One-token fingerprint for flight breadcrumbs (checkpoint
+        events carry this so a restored run can prove where it saved)."""
+        if self.env_fingerprint is None:
+            return None
+        return self.env_fingerprint.compact()
+
     def snapshot(self, meta: Optional[dict] = None) -> dict:
         m = {"job": self.job_name}
+        if self.env_fingerprint is not None:
+            m["env"] = self.env_fingerprint.to_dict()
         m.update(meta or {})
         # profile first so its gauges land in this snapshot's series
         prof = self.profiler.profile() if self.profiler is not None else None
@@ -418,11 +458,19 @@ class _NullJobObs:
     flight_dump_path = ""
     server = None
     tenancy = None
+    resources = None
+    env_fingerprint = None
 
     __slots__ = ()
 
     def operator(self, name: str):
         return NULL_OPERATOR_OBS
+
+    def env_snapshot(self):
+        return None
+
+    def env_compact(self):
+        return None
 
     def ensure_health(self):
         return None

@@ -17,6 +17,9 @@
 * ``GET /tenants.json``  — per-tenant fleet view (admission/emit/error
   rates, SLO levels, budget burn) when a JobServer is attached; 404 on
   single-job runs
+* ``GET /env.json``      — the environment fingerprint (usable cores,
+  cgroup quota, NUMA nodes, jax backend/devices, hostname hash;
+  obs/resources.py); 404 when collection failed
 
 Everything else is 404; non-GET methods are 405. The server is pure
 stdlib (no deps), started/stopped by ``execute_job`` alongside the
@@ -120,6 +123,17 @@ class MetricsServer:
                         404,
                         "application/json",
                         b'{"error": "no tenancy attached (single-job run)"}',
+                    )
+                body = json.dumps(view, default=str).encode("utf-8")
+                return 200, "application/json", body
+            if path == "/env.json":
+                env = getattr(self._provider, "env_snapshot", None)
+                view = env() if env is not None else None
+                if view is None:
+                    return (
+                        404,
+                        "application/json",
+                        b'{"error": "no environment fingerprint"}',
                     )
                 body = json.dumps(view, default=str).encode("utf-8")
                 return 200, "application/json", body

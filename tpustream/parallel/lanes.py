@@ -356,6 +356,15 @@ def lane_worker_main(
     specs, checked at each frame's sequence number before parsing.
     """
     in_ring = out_ring = None
+    # kernel-visible identity: the multiprocessing name is Python-only,
+    # so without this every lane reads as "python" in ps/top and in the
+    # /proc/<pid>/comm the obs ResourceSampler attributes CPU time by.
+    # comm is capped at 15 bytes; best-effort (no /proc off Linux).
+    try:
+        with open("/proc/self/comm", "w") as f:
+            f.write(f"tsm-lane{lane_id}")
+    except OSError:
+        pass
     try:
         in_ring = ShmRing(in_size, name=in_name)
         out_ring = ShmRing(out_size, name=out_name)

@@ -2442,6 +2442,13 @@ def execute_job(env, sink_nodes) -> JobResult:
         from .supervisor import _install_lane_restart_health_rule
 
         _install_lane_restart_health_rule(env)
+        # resource plane (obs/resources.py): when the /proc sampler is
+        # on, core contention between lane workers surfaces as the same
+        # kind of built-in WARN transition
+        if getattr(env.config.obs, "resources", False):
+            from .supervisor import _install_lane_contention_health_rule
+
+            _install_lane_contention_health_rule(env)
     if getattr(env.config, "restart_strategy", None) is not None:
         from .supervisor import supervise
 
@@ -2988,6 +2995,12 @@ def _execute_job(env, sink_nodes) -> JobResult:
         )
         if ingest_plane is not None:
             prepared = ingest_plane.frames(source_batches, _prepare)
+            # per-lane CPU attribution (obs/resources.py): the sampler
+            # re-reads the PID map at every tick, so lane respawns are
+            # tracked without re-attachment
+            resources = getattr(job_obs, "resources", None)
+            if resources is not None:
+                resources.attach_lanes(ingest_plane.lane_pids)
     prefetched = (
         cfg.parse_ahead > 0
         and jax.process_count() == 1
@@ -3243,6 +3256,9 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 batches=metrics.batches,
                 source_pos=lines_consumed,
                 save_ms=round(ck_sw.elapsed * 1000.0, 3),
+                # environment stamp (obs/resources.py): a restored run
+                # can prove what host/backend wrote the snapshot
+                env=job_obs.env_compact(),
             )
         t_iter_done = time.perf_counter()
         if sb.final:

@@ -880,6 +880,35 @@ class IngestPlane:
         lane.merged += 1
         return sb, batch, None, hw
 
+    # -- resource-plane export (obs/resources.py) ---------------------------
+
+    def lane_pids(self) -> dict:
+        """Live lane worker PIDs keyed by lane index, for per-lane CPU
+        attribution by the obs ResourceSampler. Re-read at every sample
+        tick, so a respawned incarnation shows up under its lane index
+        with the fresh PID; folded/done lanes drop out."""
+        with self._cv:
+            out = {}
+            for lane in self._lanes:
+                if lane.state != "up":
+                    continue
+                pid = getattr(lane.inc.proc, "pid", None)
+                if pid:
+                    out[lane.idx] = pid
+            return out
+
+    def lane_heartbeat_ages(self) -> dict:
+        """Seconds since each live lane's worker last pulsed, keyed by
+        lane index — the watchdog's stall signal, exported so resource
+        samples can distinguish a starved lane (high heartbeat age, low
+        CPU) from a busy one."""
+        with self._cv:
+            return {
+                lane.idx: lane.inc.heartbeat_age_s()
+                for lane in self._lanes
+                if lane.state == "up"
+            }
+
     # -- checkpoint / shutdown ---------------------------------------------
 
     def cursor(self) -> dict:

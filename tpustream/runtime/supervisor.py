@@ -125,6 +125,7 @@ class RestartStrategies:
 
 RESTART_HEALTH_RULE_NAME = "job_restarted"
 LANE_RESTART_HEALTH_RULE_NAME = "ingest_lane_restarted"
+LANE_CONTENTION_HEALTH_RULE_NAME = "lane_core_contention"
 
 
 class SupervisionState:
@@ -210,6 +211,17 @@ def _install_lane_restart_health_rule(env) -> None:
     invisible outside the flight ring."""
     _install_builtin_health_rule(
         env, LANE_RESTART_HEALTH_RULE_NAME, "ingest_lane_restarts_total"
+    )
+
+
+def _install_lane_contention_health_rule(env) -> None:
+    """Built-in WARN rule for the resource plane: trips once the
+    ResourceSampler has observed lane workers contending for a core
+    (two busy lanes on the same core, or the whole plane pinned at ~1
+    core of CPU). Turns the r07 inverse-scaling pathology — lanes added,
+    throughput halved, nothing alerted — into a health transition."""
+    _install_builtin_health_rule(
+        env, LANE_CONTENTION_HEALTH_RULE_NAME, "lane_core_contention_total"
     )
 
 
