@@ -420,6 +420,75 @@ def trace_overhead_probe():
     }
 
 
+def ledger_overhead_probe():
+    """Phase O3: conservation-ledger cost + parity (ISSUE 18). Runs the
+    phase-O tiny chapter3 job twice — obs-on with the ledger explicitly
+    off, then the same job with the ledger on (auto + digests) — and
+    reports the wall-clock overhead of the accounting leg, whether the
+    collected rows stayed byte-identical (the ledger observes the emit
+    path, it never touches a record), and the per-edge residual summary
+    with the digest anchors, so every round carries the conservation
+    proof next to its rates."""
+    from tpustream import StreamExecutionEnvironment, Time, TimeCharacteristic
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs.chapter3_bandwidth_eventtime import build
+    from tpustream.runtime.sources import ReplaySource
+
+    lines = [
+        f"2020-01-01T00:{m:02d}:{s:02d} ch{(m * 12 + s) % 3} "
+        f"{100 + (m * 60 + s) % 997}"
+        for m in range(3)
+        for s in range(0, 60, 5)
+    ]
+
+    def run(ledger):
+        cfg = StreamConfig(
+            batch_size=16,
+            key_capacity=64,
+            obs=ObsConfig(enabled=True, ledger=ledger),
+        )
+        env = StreamExecutionEnvironment(cfg)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        out = build(
+            env,
+            env.add_source(ReplaySource(lines)),
+            size=Time.minutes(5),
+            slide=Time.seconds(5),
+            delay=Time.minutes(1),
+        ).collect()
+        t0 = time.perf_counter()
+        env.execute("ledger-probe")
+        wall = time.perf_counter() - t0
+        return wall, out.items, env.metrics
+
+    base_wall, base_rows, _ = run(False)
+    led_wall, led_rows, m = run(None)  # None = auto: on with obs on
+    snap = m.obs_snapshot(meta={"phase": "O3"})
+    led = snap.get("ledger") or {}
+    residuals = {
+        e["edge"]: e.get("residual") for e in led.get("edges", [])
+    }
+    evaluated = [r for r in residuals.values() if r is not None]
+    overhead = (
+        (led_wall - base_wall) / base_wall * 100.0 if base_wall else 0.0
+    )
+    return {
+        "base_wall_s": round(base_wall, 6),
+        "ledger_wall_s": round(led_wall, 6),
+        "overhead_pct": round(overhead, 3),
+        "sink_digest_base": _sink_digest(base_rows),
+        "sink_digest_ledger": _sink_digest(led_rows),
+        "output_identical": _sink_digest(base_rows) == _sink_digest(led_rows),
+        "edges_evaluated": len(evaluated),
+        "residuals": residuals,
+        "all_residuals_zero": bool(evaluated)
+        and all(r == 0 for r in evaluated),
+        "violations": led.get("violations", {}).get("total", 0),
+        "anchors": led.get("anchors", {}),
+        "ticks": led.get("ticks", 0),
+    }
+
+
 def recovery_probe():
     """Phase R: supervised-execution probe (docs/recovery.md). Runs a
     small checkpointed chapter2 job twice — clean, then with an injected
@@ -2591,6 +2660,20 @@ def run_bench():
     except Exception as e:  # pragma: no cover
         log(f"phase O2 skipped: {e}")
 
+    # ---- Phase O3: conservation-ledger overhead probe -------------------
+    ledger_probe = None
+    try:
+        ledger_probe = ledger_overhead_probe()
+        log(
+            f"phase O3: conservation ledger -> "
+            f"{ledger_probe['overhead_pct']:+.1f}% wall overhead, "
+            f"{ledger_probe['edges_evaluated']} edge(s) evaluated, "
+            f"all residuals zero: {ledger_probe['all_residuals_zero']}, "
+            f"output identical: {ledger_probe['output_identical']}"
+        )
+    except Exception as e:  # pragma: no cover
+        log(f"phase O3 skipped: {e}")
+
     # ---- Phase R: supervised recovery probe -----------------------------
     recovery = None
     try:
@@ -2764,6 +2847,11 @@ def run_bench():
                     # proof, and a trimmed unified Perfetto timeline
                     # (docs/observability.md "Flight-path tracing")
                     "tracing": tracing,
+                    # phase O3: conservation-ledger cost — the on/off
+                    # wall overhead, the byte-identical-output proof,
+                    # and the per-edge residual + anchor summary
+                    # (docs/observability.md "Conservation ledger")
+                    "ledger": ledger_probe,
                     # phase R: what supervised execution costs and
                     # delivers after an injected mid-stream crash
                     # (docs/recovery.md)

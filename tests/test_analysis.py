@@ -592,6 +592,51 @@ def test_tsm019_clean_configuration():
     assert "TSM019" not in codes(env.analyze())
 
 
+def test_tsm051_dead_ledger():
+    # ledger explicitly on but obs off: residuals are never evaluated
+    env = good_job(make_env(obs=ObsConfig(ledger=True)))
+    f = next(f for f in env.analyze() if f.code == "TSM051")
+    assert f.severity == ERROR
+    assert "dead ledger" in f.message
+    # obs on but no snapshot ticks to drive the evaluator: same shape
+    env = good_job(make_env(obs=ObsConfig(
+        enabled=True, snapshot_interval_s=0.0, ledger=True,
+    )))
+    assert any(
+        f.code == "TSM051" and f.severity == ERROR for f in env.analyze()
+    )
+
+
+def test_tsm051_anchors_never_land():
+    # explicit ledger + digests but no checkpointing: sha256 folded per
+    # row, no anchor ever written -> WARN
+    env = good_job(make_env(obs=ObsConfig(
+        enabled=True, snapshot_interval_s=0.5, ledger=True,
+    )))
+    f = next(f for f in env.analyze() if f.code == "TSM051")
+    assert f.severity == WARN
+    assert "anchor" in f.message
+
+
+def test_tsm051_clean_configurations():
+    # the auto-on default (ledger=None) must never be noisy, even
+    # without checkpointing
+    env = good_job(make_env(obs=ObsConfig(enabled=True)))
+    assert "TSM051" not in codes(env.analyze())
+    # explicit ledger with digests riding real checkpoints: silent
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm051-ck", checkpoint_interval_batches=2,
+        obs=ObsConfig(enabled=True, snapshot_interval_s=0.5, ledger=True),
+    ))
+    assert "TSM051" not in codes(env.analyze())
+    # explicit ledger without digests needs no checkpoints: silent
+    env = good_job(make_env(obs=ObsConfig(
+        enabled=True, snapshot_interval_s=0.5, ledger=True,
+        ledger_digests=False,
+    )))
+    assert "TSM051" not in codes(env.analyze())
+
+
 def test_findings_sorted_errors_first():
     # one ERROR (TSM013) + one INFO (TSM010) in a single graph
     env = make_env(async_depth=2)
@@ -798,7 +843,7 @@ def test_catalog_is_stable():
         "TSM019", "TSM020", "TSM021",
         "TSM022", "TSM023", "TSM024", "TSM025", "TSM030", "TSM031",
         "TSM032", "TSM033", "TSM034", "TSM040", "TSM041", "TSM042",
-        "TSM043", "TSM044", "TSM045", "TSM046", "TSM047",
+        "TSM043", "TSM044", "TSM045", "TSM046", "TSM047", "TSM051",
     }
     assert expected <= set(CATALOG)
     for code, rule in CATALOG.items():

@@ -115,6 +115,23 @@ def test_tenant_slo_probe_tiny_mode(bench):
     assert d["tenants_json_scrape_ms"] >= 0
 
 
+def test_ledger_overhead_probe_tiny_mode(bench):
+    """Phase O3 in tiny mode: the ledger on/off legs both run, the
+    collected rows stay byte-identical (the ledger never touches a
+    record), every evaluated invariant's residual is exactly zero, no
+    violation latched, and both sinks carry a digest anchor."""
+    d = bench.ledger_overhead_probe()
+    assert d["output_identical"]
+    assert d["sink_digest_base"] == d["sink_digest_ledger"]
+    assert d["edges_evaluated"] >= 3  # source, sink0, contents edges
+    assert d["all_residuals_zero"]
+    assert all(r == 0 for r in d["residuals"].values() if r is not None)
+    assert d["violations"] == 0
+    assert "sink0" in d["anchors"]
+    a = d["anchors"]["sink0"]
+    assert a["count"] > 0 and len(a["digest"]) == 64 and a["verifiable"]
+
+
 def test_compare_smoke_same_env(bench, tmp_path):
     """Schema-2 records minted on this host compare cleanly: the env
     fingerprint matches itself, per-phase deltas come out, and the CI
@@ -125,13 +142,19 @@ def test_compare_smoke_same_env(bench, tmp_path):
         "bench_schema": bench.BENCH_SCHEMA,
         "env": env,
         "value": 100.0,
-        "round_detail": {"sync_rows_per_s": 1000.0},
+        "round_detail": {
+            "sync_rows_per_s": 1000.0,
+            "ledger": {"overhead_pct": 2.0},
+        },
     }
     old = tmp_path / "old.json"
     old.write_text(json.dumps(rec))
     new = tmp_path / "new.json"
     new.write_text(
-        json.dumps(dict(rec, round_detail={"sync_rows_per_s": 1500.0}))
+        json.dumps(dict(rec, round_detail={
+            "sync_rows_per_s": 1500.0,
+            "ledger": {"overhead_pct": 1.0},
+        }))
     )
     loaded = bench.load_bench_record(str(old))
     assert loaded["error"] is None
@@ -140,4 +163,11 @@ def test_compare_smoke_same_env(bench, tmp_path):
     cmp = bench.compare_records(loaded, bench.load_bench_record(str(new)))
     assert cmp["comparable"] is True
     assert any(d["phase"] == "sync_rows_per_s" for d in cmp["deltas"])
+    # the ledger phase flattens in, and less overhead is an improvement
+    assert any(
+        d["phase"] == "ledger.overhead_pct" for d in cmp["deltas"]
+    )
+    assert any(
+        d["phase"] == "ledger.overhead_pct" for d in cmp["improvements"]
+    )
     assert bench.run_compare([str(old), str(new)], gate=True) == 0

@@ -1,0 +1,402 @@
+"""Dataflow conservation ledger (tpustream/obs/ledger.py): per-edge
+record accounting, checkpoint-anchored output digests, the auto-installed
+CRIT health rule, and the ledger-never-touches-a-record parity contract.
+
+The ledger observes the emit path — it must never change a job's output
+(byte-identical on vs off), every accounted invariant must hold at
+exactly zero residual across the chapter jobs, a restored attempt must
+verify its sinks against the checkpoint's digest anchors, and a
+hand-tampered sink must trip CRIT. Device-free unit coverage of the
+ledger internals lives in the dump selftest (`dump --selftest`).
+"""
+
+import pytest
+
+from tpustream import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    StreamExecutionEnvironment,
+    Time,
+    TimeCharacteristic,
+    Tuple3,
+)
+from tpustream.config import ObsConfig, StreamConfig
+from tpustream.runtime.sources import ReplaySource
+from tpustream.runtime.supervisor import (
+    LEDGER_HEALTH_RULE_NAME,
+    fixed_delay,
+)
+from tpustream.testing import FaultInjector, FaultPoint
+
+LINES = [
+    "1563452056 10.8.22.1 cpu0 80.5",
+    "1563452050 10.8.22.1 cpu0 78.4",
+    "1563452056 10.8.22.2 cpu1 40.0",
+    "1563452060 10.8.22.1 cpu0 99.9",
+    "1563452061 10.8.22.2 cpu1 10.0",
+    "1563452062 10.8.22.1 cpu0 50.0",
+]
+
+
+def run_job(
+    items=LINES, build=None, ckdir=None, strategy=None, injector=None,
+    **over
+):
+    """One chapter2 job run; returns (env, collected items, JobResult)."""
+    if build is None:
+        from tpustream.jobs.chapter2_max import build
+    over.setdefault("batch_size", 2)
+    cfg = StreamConfig(**over)
+    if ckdir is not None:
+        cfg = cfg.replace(
+            checkpoint_dir=str(ckdir), checkpoint_interval_batches=1
+        )
+    if injector is not None:
+        cfg = injector.install(cfg)
+    env = StreamExecutionEnvironment(cfg)
+    if strategy is not None:
+        env.set_restart_strategy(strategy)
+    handle = build(env, env.add_source(ReplaySource(items))).collect()
+    result = env.execute("ledger-test")
+    return env, handle.items, result
+
+
+def _ledger_state(result):
+    led = result.metrics.job_obs.ledger
+    assert led is not None, "ledger expected on for this config"
+    return led.state()
+
+
+def _evaluated_residuals(state):
+    return {
+        e["edge"]: e["residual"]
+        for e in state["edges"]
+        if e.get("residual") is not None
+    }
+
+
+# ---------------------------------------------------------------------------
+# parity: the ledger observes, it never touches a record
+# ---------------------------------------------------------------------------
+def test_ledger_output_byte_identical_single_chip():
+    """Obs off, obs on with the ledger explicitly off, and obs on with
+    the ledger auto-on (digests folding every row) all collect the
+    exact same items — the headline no-interference contract."""
+    _, plain, _ = run_job(obs=ObsConfig(enabled=False))
+    _, led_off, _ = run_job(obs=ObsConfig(enabled=True, ledger=False))
+    _, led_on, res = run_job(obs=ObsConfig(enabled=True))
+    assert led_on == plain
+    assert led_off == plain
+    state = _ledger_state(res)
+    assert state["violations"]["total"] == 0
+    assert all(r == 0 for r in _evaluated_residuals(state).values())
+
+
+@pytest.mark.slow
+def test_ledger_output_byte_identical_p8():
+    """Same parity contract on an 8-shard mesh."""
+    _, plain, _ = run_job(
+        batch_size=8, parallelism=8, obs=ObsConfig(enabled=False)
+    )
+    _, led_on, res = run_job(
+        batch_size=8, parallelism=8, obs=ObsConfig(enabled=True)
+    )
+    assert led_on == plain
+    state = _ledger_state(res)
+    assert state["violations"]["total"] == 0
+    assert all(r == 0 for r in _evaluated_residuals(state).values())
+
+
+# ---------------------------------------------------------------------------
+# invariants hold at zero across job shapes
+# ---------------------------------------------------------------------------
+def test_ledger_residuals_zero_and_anchored():
+    """The chapter2 job with the ledger on: source/sink/contents edges
+    all present and balanced, the snapshot carries the ledger section,
+    and the collect sink's anchor is a verifiable sha256 over what it
+    actually holds."""
+    _, out, res = run_job(obs=ObsConfig(enabled=True))
+    state = _ledger_state(res)
+    residuals = _evaluated_residuals(state)
+    assert {"source", "sink0", "contents:sink0"} <= set(residuals)
+    assert all(r == 0 for r in residuals.values()), residuals
+    src = next(e for e in state["edges"] if e["edge"] == "source")
+    assert src["offered"] == len(LINES)
+    a = state["anchors"]["sink0"]
+    assert a["count"] == len(out)
+    assert a["verifiable"] and len(a["digest"]) == 64
+
+    snap = res.metrics.obs_snapshot()
+    assert snap["ledger"]["violations"]["total"] == 0
+    # residual gauges mint edge-labelled into the registry
+    assert any(
+        s["name"] == "ledger_conservation_residual"
+        and s["labels"].get("edge") == "sink0"
+        for s in snap["metrics"]["series"]
+    )
+
+
+def test_ledger_chain_edge_balances():
+    """Two chained device stages: the hand-off edge accounts every row
+    (handed == received + buffered) and the re-keyed output is intact."""
+
+    class Ts(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(1000))
+
+        def extract_timestamp(self, value):
+            return int(value.split(" ")[0])
+
+    def parse(line: str) -> Tuple3:
+        items = line.split(" ")
+        return Tuple3(items[1], items[2], int(items[3]))
+
+    lines = [
+        "1000 a x 5", "2000 b y 7", "5000 a x 3",
+        "12000 a y 4", "25000 b x 9",
+    ]
+    env = StreamExecutionEnvironment(
+        StreamConfig(
+            batch_size=2, key_capacity=16, obs=ObsConfig(enabled=True)
+        )
+    )
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    stage1 = (
+        env.add_source(ReplaySource(lines))
+        .assign_timestamps_and_watermarks(Ts())
+        .map(parse)
+        .key_by(0)
+        .time_window(Time.seconds(10))
+        .reduce(lambda p, q: Tuple3(p.f0, p.f1, p.f2 + q.f2))
+    )
+    handle = stage1.key_by(1).max(2).collect()
+    result = env.execute("ledger-chain")
+    assert len(handle.items) == 4
+    state = _ledger_state(result)
+    chain = [e for e in state["edges"] if e["edge"].startswith("chain:")]
+    assert chain, state["edges"]
+    assert chain[0]["handed"] == chain[0]["received"] == 4
+    assert chain[0]["residual"] == 0
+    assert all(
+        r == 0 for r in _evaluated_residuals(state).values()
+    )
+
+
+def test_ledger_lanes_carveout_source_informational():
+    """ingest_lanes > 1 parses in lane workers this ledger's host-op
+    counters cannot see: the source edge reports informationally
+    (residual None + note) while sink/contents edges stay exact."""
+    _, plain, _ = run_job(obs=ObsConfig(enabled=False))
+    _, out, res = run_job(ingest_lanes=2, obs=ObsConfig(enabled=True))
+    assert out == plain
+    state = _ledger_state(res)
+    src = next(e for e in state["edges"] if e["edge"] == "source")
+    assert src["residual"] is None
+    assert "note" in src
+    residuals = _evaluated_residuals(state)
+    assert "source" not in residuals
+    assert residuals.get("sink0") == 0
+    assert state["violations"]["total"] == 0
+
+
+def test_ledger_cep_side_output_edges_balance():
+    """A CEP job with a timeout side output: the ``side:<tag>`` emit
+    edge and its contents invariant both evaluate to zero, alongside
+    the main match sink, and the ledger changes neither stream."""
+    from tpustream import CEP, OutputTag, Pattern
+
+    class Ts(BoundedOutOfOrdernessTimestampExtractor):
+        def __init__(self):
+            super().__init__(Time.milliseconds(0))
+
+        def extract_timestamp(self, line):
+            return int(line.split(" ")[0]) * 1000
+
+    def parse(line):
+        t, ch, v = line.split(" ")
+        return Tuple3(int(t), ch, int(v))
+
+    def run(obs):
+        env = StreamExecutionEnvironment(
+            StreamConfig(batch_size=1, obs=obs)
+        )
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        pat = (
+            Pattern.begin("a").where(lambda r: r.f2 > 10)
+            .followed_by("b").where(lambda r: r.f2 > 10)
+            .within(Time.seconds(10))
+        )
+        tag = OutputTag("to")
+        # k1 completes a->b at t=9 (match); the completing event also
+        # begins a fresh partial whose within bound expires when the
+        # t=30 watermark sweeps — both streams carry rows
+        lines = ["0 k1 20", "0 k2 20", "9 k1 30", "30 k1 50"]
+        keyed = (
+            env.add_source(ReplaySource(lines))
+            .assign_timestamps_and_watermarks(Ts())
+            .map(parse)
+            .key_by(1)
+        )
+        result = CEP.pattern(keyed, pat).select(None, timeout_tag=tag)
+        h = result.collect()
+        ht = result.get_side_output(tag).collect()
+        res = env.execute("ledger-cep")
+        return h.items, ht.items, res
+
+    main0, side0, _ = run(ObsConfig(enabled=False))
+    main1, side1, res = run(ObsConfig(enabled=True))
+    assert main1 == main0 and side1 == side0
+    assert main1 and side1, "both streams must carry rows"
+    state = _ledger_state(res)
+    residuals = _evaluated_residuals(state)
+    assert {"sink0", "side:to", "contents:side:to"} <= set(residuals)
+    assert all(r == 0 for r in residuals.values()), residuals
+    side_edge = next(
+        e for e in state["edges"] if e["edge"] == "side:to"
+    )
+    assert side_edge["emitted"] == len(side1)
+    a = state["anchors"]["side:to"]
+    assert a["count"] == len(side1) and len(a["digest"]) == 64
+    assert state["violations"]["total"] == 0
+
+
+def test_ledger_digest_gate():
+    """ledger_digests=False keeps the counting edges but skips the
+    per-row hashing: anchors carry counts with digest None."""
+    _, out, res = run_job(
+        obs=ObsConfig(enabled=True, ledger_digests=False)
+    )
+    state = _ledger_state(res)
+    assert state["digests"] is False
+    a = state["anchors"]["sink0"]
+    assert a["count"] == len(out) and a["digest"] is None
+    assert all(r == 0 for r in _evaluated_residuals(state).values())
+
+
+# ---------------------------------------------------------------------------
+# sink counter naming: one labeled family + back-compat spellings
+# ---------------------------------------------------------------------------
+def test_sink_counter_twin_naming_regression():
+    """The legacy per-sink spelling (`operator_sink0_emitted`) and the
+    unified labeled family (`operator_sink_emitted{sink="0"}`) are fed
+    by one TwinCounter — both appear in the Prometheus exposition with
+    the same value."""
+    import re
+
+    _, out, res = run_job(obs=ObsConfig(enabled=True))
+    prom = res.metrics.obs_snapshot()["prometheus"]
+    legacy = re.search(
+        r'tpustream_operator_sink0_emitted\{[^}]*\} (\d+)', prom
+    )
+    unified = re.search(
+        r'tpustream_operator_sink_emitted\{[^}]*sink="0"[^}]*\} (\d+)',
+        prom,
+    )
+    assert legacy, "legacy spelling missing from exposition"
+    assert unified, "unified labeled family missing from exposition"
+    assert legacy.group(1) == unified.group(1) == str(len(out))
+
+
+# ---------------------------------------------------------------------------
+# recovery: digest anchors prove byte parity across a restore
+# ---------------------------------------------------------------------------
+def test_sink_emit_fault_recovery_verifies_anchors(tmp_path):
+    """An injected sink_emit fault kills the attempt mid-stream; the
+    supervisor restores from the latest checkpoint, truncates the
+    collect sink, and the ledger re-derives its digest over the
+    truncated contents against the checkpoint's anchor — zero
+    mismatches, zero residuals, output byte-identical to a clean run."""
+    _, clean, _ = run_job()
+    inj = FaultInjector(FaultPoint("sink_emit", at=3))
+    _, out, res = run_job(
+        ckdir=tmp_path, strategy=fixed_delay(3, 0.0), injector=inj,
+        obs=ObsConfig(enabled=True),
+    )
+    assert inj.fired == 1
+    assert out == clean
+    state = _ledger_state(res)
+    assert state["restore"] is not None, "restore verification must run"
+    assert state["restore"]["mismatches"] == 0
+    assert state["restore"]["verified"] >= 1
+    assert state["violations"]["total"] == 0
+    assert all(r == 0 for r in _evaluated_residuals(state).values())
+    # no mismatch breadcrumb anywhere in the shared supervised ring
+    kinds = [e["kind"] for e in res.metrics.job_obs.flight.events()]
+    assert "ledger_restore_digest_mismatch" not in kinds
+    assert "ledger_violation" not in kinds
+
+
+def test_checkpoints_carry_ledger_anchors(tmp_path):
+    """Checkpoint meta rides the per-sink anchors (optional key, no
+    format bump) and a no-ledger load still works."""
+    from tpustream.runtime.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+    )
+
+    run_job(ckdir=tmp_path, obs=ObsConfig(enabled=True))
+    path = latest_checkpoint(str(tmp_path))
+    assert path is not None
+    ck = load_checkpoint(path)
+    assert ck.ledger is not None
+    assert "sink0" in ck.ledger
+    assert ck.ledger["sink0"]["verifiable"]
+    assert len(ck.ledger["sink0"]["digest"]) == 64
+
+    # a ledger-off run writes checkpoints without the key
+    run_job(ckdir=tmp_path / "off", obs=ObsConfig(enabled=False))
+    ck2 = load_checkpoint(latest_checkpoint(str(tmp_path / "off")))
+    assert ck2.ledger is None
+
+
+# ---------------------------------------------------------------------------
+# the deliberately broken sink: caught, latched, CRIT
+# ---------------------------------------------------------------------------
+def test_hand_broken_sink_trips_crit_rule():
+    """A row removed from a collect handle behind the emit path (the
+    hand-tampered sink) trips the contents invariant on the next
+    evaluation: residual gauge nonzero, one latched violation, a
+    ledger_violation breadcrumb, and the auto-installed health rule
+    goes CRIT."""
+    env, out, res = run_job(obs=ObsConfig(enabled=True))
+    jo = res.metrics.job_obs
+    state = jo.ledger.state()
+    assert state["violations"]["total"] == 0
+
+    # break the sink: drop the last collected row behind the ledger
+    # (``out`` IS the collect handle's retained list), then drive one
+    # snapshot tick — the production path: pre-hook refresh mints the
+    # residual, health evaluates over the fresh series
+    assert out, "job must have collected rows for the tamper to matter"
+    out.pop()
+    snap = jo.snapshotter.take()
+    led = snap["ledger"]
+    assert led["violations"]["total"] == 1
+    assert "contents:sink0" in led["violations"]["edges"]
+    bad = next(
+        e for e in led["edges"] if e["edge"] == "contents:sink0"
+    )
+    assert bad["residual"] == 1
+    assert any(
+        e["kind"] == "ledger_violation"
+        and e.get("edge") == "contents:sink0"
+        for e in jo.flight.events()
+    )
+    rule = next(
+        r for r in snap["health"]["rules"]
+        if r["rule"] == LEDGER_HEALTH_RULE_NAME
+    )
+    assert rule["level"] == "crit"
+
+
+def test_ledger_off_means_no_surfaces():
+    """ledger=False: no ledger object, no snapshot section, no
+    auto-installed health rule."""
+    env, _, res = run_job(obs=ObsConfig(enabled=True, ledger=False))
+    assert res.metrics.job_obs.ledger is None
+    snap = res.metrics.obs_snapshot()
+    assert "ledger" not in snap
+    names = {
+        (r.get("name") if isinstance(r, dict) else getattr(r, "name", ""))
+        for r in (env.config.obs.health_rules or ())
+    }
+    assert LEDGER_HEALTH_RULE_NAME not in names

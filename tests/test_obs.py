@@ -259,7 +259,7 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 109
+    assert int(m.group(1)) == 122
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     # ... and the sharded-ingestion lane series
@@ -282,6 +282,11 @@ def test_dump_selftest_smoke(capsys):
     assert "ok: flight events export as instants" in out
     assert "ok: tracer ring overflow counts drops" in out
     assert "ok: /trace.json serves the timeline" in out
+    # the conservation-ledger checks are part of the suite
+    assert "ok: balanced edges evaluate to zero residuals" in out
+    assert "ok: hand-tampered sink trips the contents edge" in out
+    assert "ok: forged anchor flags a restore digest mismatch" in out
+    assert "ok: ledger.json round-trips the state" in out
 
 
 # ---------------------------------------------------------------------------

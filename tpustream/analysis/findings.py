@@ -432,6 +432,21 @@ CATALOG = {
             "none required; pin parallelism across restarts to skip the "
             "rescale permutation",
         ),
+        Rule(
+            "TSM051", ERROR, "conservation ledger configured but cannot run",
+            "obs.ledger=True with observability off (or a zero snapshot "
+            "interval) is a dead ledger: the accounts live on the "
+            "metrics registry and residuals are only evaluated at "
+            "snapshot ticks, so conservation is never checked while the "
+            "config claims it is. The WARN shape: an explicitly-enabled "
+            "ledger with digest anchoring on but checkpointing off — "
+            "digests are computed per row yet no (count, digest) anchor "
+            "ever lands in a snapshot, so restores have nothing to "
+            "verify against.",
+            "enable obs with snapshot_interval_s > 0 (or drop "
+            "obs.ledger=True); for anchored digests also set "
+            "checkpoint_dir + checkpoint_interval",
+        ),
     ]
 }
 

@@ -705,6 +705,50 @@ def check_resource_sampling(ctx) -> Iterable[Finding]:
 
 
 @rule
+def check_ledger_config(ctx) -> Iterable[Finding]:
+    """TSM051: conservation ledger configured so it cannot run, or so
+    its digest anchors never land.
+
+    The ledger's residuals are only evaluated at Snapshotter ticks, so
+    an explicit ``obs.ledger=True`` with obs disabled or a zero
+    snapshot interval is a dead ledger — every account is counted but
+    conservation is never checked (ERROR). The quieter shape: an
+    explicitly-enabled ledger with digests on but checkpointing off
+    folds a sha256 per emitted row yet no (count, digest) anchor ever
+    lands, so restores have nothing to verify against (WARN). Both
+    arms require ``ledger is True``: the auto-on default (``None``
+    with obs enabled) must not make every checkpoint-less job noisy.
+    """
+    obs = ctx.cfg.obs
+    if getattr(obs, "ledger", None) is not True:
+        return
+    interval = float(getattr(obs, "snapshot_interval_s", 0.0) or 0.0)
+    if not obs.enabled or interval <= 0:
+        yield make_finding(
+            "TSM051", None,
+            f"obs.ledger=True with obs.enabled={obs.enabled} and "
+            f"snapshot_interval_s={interval:g}: conservation residuals "
+            "are only evaluated at snapshot ticks, so the ledger "
+            "counts but never checks (dead ledger)",
+        )
+        return
+    ck_on = bool(ctx.cfg.checkpoint_dir) and \
+        ctx.cfg.checkpoint_interval_batches > 0
+    if getattr(obs, "ledger_digests", True) and not ck_on:
+        yield make_finding(
+            "TSM051", None,
+            "obs.ledger=True with ledger_digests on but checkpointing "
+            f"disabled (checkpoint_dir={ctx.cfg.checkpoint_dir!r}, "
+            f"interval={ctx.cfg.checkpoint_interval_batches}): digests "
+            "are folded per emitted row yet no (count, digest) anchor "
+            "ever lands in a checkpoint, so restores have nothing to "
+            "verify against (set ledger_digests=False or enable "
+            "checkpointing)",
+            severity=WARN,
+        )
+
+
+@rule
 def check_unproduced_side_output(ctx) -> Iterable[Finding]:
     """TSM013: get_side_output(tag) where the parent never emits tag."""
     for chain in ctx.chains:

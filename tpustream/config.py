@@ -131,6 +131,22 @@ class ObsConfig:
     # lookback window for the continuous pipeline profiler's per-stage
     # shares / binding stage (the "profile" snapshot section)
 
+    # -- dataflow conservation ledger (obs/ledger.py) -----------------------
+    ledger: Optional[bool] = None
+    # per-edge record conservation accounting: source admission, chained
+    # hand-offs, terminal/side sink fan-out, retained-sink contents —
+    # residuals mint ledger_conservation_residual{edge} gauges, and the
+    # first nonzero residual latches ledger_violations_total + a
+    # ledger_violation breadcrumb behind an auto-installed CRIT health
+    # rule. None (default) = auto: on whenever obs is enabled; the
+    # ledger lives on the registry so True with obs off is dead config
+    # (analyzer rule TSM051). Forced off under multi-host execution.
+    ledger_digests: bool = True
+    # fold every emitted row into a per-sink rolling sha256; checkpoints
+    # carry the (count, digest) anchors and supervised restores
+    # re-derive + verify them (ledger_restore_digest_mismatch). One hash
+    # update per emitted row — turn off to keep counting-only ledgers.
+
     # -- adaptive pipeline controller (runtime/controller.py) ---------------
     adaptive: bool = False
     # master switch, STRICTLY off by default: at snapshot ticks an

@@ -69,6 +69,11 @@ KNOWN_SERIES = frozenset({
     "trace_spans_dropped_total", "record_traces_sampled_total",
     # analyzer
     "analysis_findings_total",
+    # conservation ledger (obs/ledger.py), residuals edge-labelled; the
+    # unified sink-emit family operator_sink_emitted{sink=...} (twin of
+    # the legacy operator_sink{i}_emitted spellings) rides the
+    # operator_ pattern below
+    "ledger_conservation_residual", "ledger_violations_total",
     # resource plane (obs/resources.py), sampled at snapshot ticks
     "host_cpu_util", "lane_cpu_util", "lane_core", "process_rss_bytes",
     "ctx_switches_total", "lane_core_contention_total",

@@ -252,6 +252,49 @@ def render_profile(prof: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def render_ledger(led: dict) -> str:
+    """Render a snapshot's ledger section (see obs/ledger.py)."""
+    v = led.get("violations", {})
+    out = [
+        f"ledger: ticks={led.get('ticks', 0)} "
+        f"digests={'on' if led.get('digests') else 'off'} "
+        f"violations={v.get('total', 0)}"
+    ]
+    if v.get("edges"):
+        out.append("  tripped: " + ", ".join(v["edges"]))
+    edges = led.get("edges", [])
+    if edges:
+        out.append(f"  {'EDGE':<24} {'RESIDUAL':>9}  TERMS")
+        for e in edges:
+            r = e.get("residual")
+            terms = " ".join(
+                f"{k}={e[k]}" for k in e
+                if k not in ("edge", "residual", "note")
+            )
+            if e.get("note"):
+                terms += f"  ({e['note']})"
+            out.append(
+                f"  {e.get('edge', '?'):<24} "
+                f"{'-' if r is None else r:>9}  {terms}"
+            )
+    anchors = led.get("anchors", {})
+    if anchors:
+        out.append(f"  {'SINK':<24} {'COUNT':>7}  DIGEST")
+        for name, a in anchors.items():
+            d = a.get("digest") or "-"
+            out.append(
+                f"  {name:<24} {a.get('count', 0):>7}  {d[:16]}"
+                + ("" if a.get("verifiable") else "  (informational)")
+            )
+    rst = led.get("restore")
+    if rst:
+        out.append(
+            f"  restore: verified={rst.get('verified', 0)} "
+            f"mismatches={rst.get('mismatches', 0)}"
+        )
+    return "\n".join(out) + "\n"
+
+
 class _FakeClock:
     """Deterministic injectable clock for the selftest's ticks."""
 
@@ -743,6 +786,189 @@ def _selftest_resources() -> list:
     return checks
 
 
+def _selftest_ledger() -> list:
+    """Checks for the conservation ledger: invariant evaluation and
+    residual gauges, violation latching + breadcrumbs, digest anchors,
+    restore verification, the /ledger.json route, and the render."""
+    import hashlib as _hashlib
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from .flightrecorder import FlightRecorder
+    from .ledger import (
+        ConservationLedger,
+        encode_row,
+        ledger_effective,
+    )
+    from .registry import MetricsRegistry
+    from .serve import MetricsServer
+
+    checks = []
+
+    class _Auto:
+        enabled = True
+        ledger = None
+
+    class _ObsOff:
+        enabled = False
+        ledger = True
+
+    class _Explicit:
+        enabled = True
+        ledger = False
+
+    checks.append(
+        ("ledger tri-state resolves (auto on, no obs, explicit off)",
+         ledger_effective(_Auto) and not ledger_effective(_ObsOff)
+         and not ledger_effective(_Explicit))
+    )
+    checks.append(
+        ("row encoding is newline-framed and type-stable",
+         encode_row("alpha") == b"alpha\n" and encode_row(7) == b"7\n")
+    )
+
+    reg = MetricsRegistry()
+    g = reg.group(job="selftest")
+    flight = FlightRecorder(capacity=16)
+
+    class _JobObs:
+        pass
+
+    jo = _JobObs()
+    jo.group = g
+    jo.flight = flight
+    jo.counter = lambda name: g.counter(name)
+
+    led = ConservationLedger(jo, digests=True)
+    items: list = []
+    acct = led.register_sink("sink0", lambda: items, persistent=True)
+    edge = led.emit_edge("sink0")
+    for v in ("alpha", "beta", "gamma"):
+        edge["in"] += 1
+        items.append(v)
+        acct.fold_tail()
+    edge["in"] += 1
+    edge["filtered"] += 1  # one row dropped by the sink's filter tail
+    led.refresh()
+    checks.append(
+        ("balanced edges evaluate to zero residuals",
+         all(e["residual"] == 0 for e in led.edges()
+             if e.get("residual") is not None))
+    )
+    checks.append(
+        ("residual gauges land in the exposition",
+         'ledger_conservation_residual{edge="sink0",job="selftest"} 0'
+         in reg.to_prometheus_text())
+    )
+    h = _hashlib.sha256()
+    for v in items:
+        h.update(encode_row(v))
+    saved = led.anchors()
+    checks.append(
+        ("anchor digest equals a fresh sha256 over the contents",
+         saved["sink0"]["count"] == 3
+         and saved["sink0"]["digest"] == h.hexdigest()
+         and saved["sink0"]["verifiable"])
+    )
+
+    # hand-tamper: a row vanishes behind the emit path
+    items.pop()
+    led.refresh()
+    led.refresh()  # latch must hold, not double-count
+    tampered = next(
+        e for e in led.edges() if e["edge"] == "contents:sink0"
+    )
+    checks.append(
+        ("hand-tampered sink trips the contents edge",
+         tampered["residual"] == 1)
+    )
+    checks.append(
+        ("violation latches exactly once",
+         led.state()["violations"]["total"] == 1
+         and led.state()["violations"]["edges"] == ["contents:sink0"])
+    )
+    checks.append(
+        ("violation leaves a flight breadcrumb",
+         any(e["kind"] == "ledger_violation"
+             and e.get("edge") == "contents:sink0"
+             for e in flight.events()))
+    )
+
+    # restore verification: the true anchor passes, a forged one trips
+    items.append("gamma")
+    led.on_restore(saved, verify=True)
+    restored_ok = led.state()["restore"]
+    led.on_restore(
+        {"sink0": {"count": 2, "digest": "00" * 32, "verifiable": True}},
+        verify=True,
+    )
+    checks.append(
+        ("restore verifies a matching anchor",
+         restored_ok["verified"] == 1 and restored_ok["mismatches"] == 0)
+    )
+    checks.append(
+        ("forged anchor flags a restore digest mismatch",
+         led.state()["restore"]["mismatches"] == 1
+         and any(e["kind"] == "ledger_restore_digest_mismatch"
+                 and e.get("sink") == "sink0"
+                 for e in flight.events()))
+    )
+    text = render_ledger(led.state())
+    checks.append(
+        ("ledger render names the edges and anchors",
+         "contents:sink0" in text and "tripped:" in text
+         and "mismatches=1" in text)
+    )
+
+    class _P:
+        def to_prometheus_text(self):
+            return reg.to_prometheus_text()
+
+        def snapshot(self):
+            return {"meta": {"job": "selftest"}}
+
+        def ledger_snapshot(self):
+            return led.state()
+
+    srv = MetricsServer(_P(), port=0)
+    srv.start()
+    try:
+        body = _json.loads(
+            urllib.request.urlopen(
+                srv.url + "/ledger.json", timeout=5
+            ).read().decode("utf-8")
+        )
+    finally:
+        srv.close()
+    checks.append(
+        ("ledger.json round-trips the state",
+         body["violations"]["total"] == 2
+         and body["digests"] is True
+         and "contents:sink0" in body["violations"]["edges"])
+    )
+
+    class _P2:
+        def to_prometheus_text(self):
+            return ""
+
+        def snapshot(self):
+            return {}
+
+    srv2 = MetricsServer(_P2(), port=0)
+    srv2.start()
+    try:
+        try:
+            urllib.request.urlopen(srv2.url + "/ledger.json", timeout=5)
+            code = 200
+        except urllib.error.HTTPError as e:
+            code = e.code
+    finally:
+        srv2.close()
+    checks.append(("ledger.json 404s when the ledger is off", code == 404))
+    return checks
+
+
 def _selftest() -> int:
     """CI smoke mode: a canned registry (hostile labels included) runs
     through snapshot -> render -> Prometheus exposition -> health
@@ -1094,6 +1320,7 @@ def _selftest() -> int:
     checks.extend(_selftest_profile())
     checks.extend(_selftest_trace())
     checks.extend(_selftest_resources())
+    checks.extend(_selftest_ledger())
     failed = [name for name, ok in checks if not ok]
     for name, ok in checks:
         sys.stdout.write(f"{'ok' if ok else 'FAIL'}: {name}\n")
@@ -1148,6 +1375,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="show only the per-tenant fleet view (tenant-labeled "
         "series joined with per-tenant SLO states and budget burn)",
+    )
+    ap.add_argument(
+        "--ledger",
+        action="store_true",
+        help="show only the conservation-ledger section (per-edge "
+        "residuals, violation latches, per-sink digest anchors)",
     )
     ap.add_argument(
         "--rules",
@@ -1219,6 +1452,15 @@ def main(argv=None) -> int:
             )
             return 1
         sys.stdout.write(json.dumps(timeline, default=str) + "\n")
+    elif args.ledger:
+        led = snap.get("ledger")
+        if not led:
+            sys.stdout.write(
+                "no ledger section in this snapshot (requires "
+                "ObsConfig.enabled with ledger on)\n"
+            )
+            return 1
+        sys.stdout.write(render_ledger(led))
     elif args.profile:
         prof = snap.get("profile")
         if not prof:

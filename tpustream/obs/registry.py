@@ -97,6 +97,32 @@ class Counter:
         return self._value
 
 
+class TwinCounter:
+    """Fan-out facade incrementing two registered counters in lockstep.
+
+    Exists for spelling migrations: the same logical count lands under
+    both a legacy flat name (``operator_sink0_emitted``) and its new
+    labeled family (``operator_sink_emitted{sink="0"}``) without the
+    instrumented code knowing there are two series. Only the write path
+    is forwarded — reads go to the registry, where both twins live as
+    ordinary counters.
+    """
+
+    __slots__ = ("a", "b")
+
+    def __init__(self, a: "Counter", b: "Counter"):
+        self.a = a
+        self.b = b
+
+    def inc(self, n: int = 1) -> None:
+        self.a.inc(n)
+        self.b.inc(n)
+
+    @property
+    def value(self) -> int:
+        return self.a.value
+
+
 class Gauge:
     """Last-write-wins scalar; ``set_fn`` installs a pull callback
     evaluated at snapshot time (queue depths, live state reads) so the

@@ -195,6 +195,10 @@ class JobObs:
         # multi-tenant fleet root (tenancy/server.py attaches itself):
         # source of the /tenants.json view and the per-tenant SLO rules
         self.tenancy = None
+        # conservation ledger (obs/ledger.py): the executor builds one
+        # per attempt and attaches it here — source of the snapshot
+        # "ledger" section and the /ledger.json view
+        self.ledger = None
         # StateMemoryTracker instances register here (obs/memory.py) so
         # the fleet can read per-tenant keyed-state breakdowns
         self.state_trackers: list = []
@@ -268,6 +272,8 @@ class JobObs:
             snap["profile"] = prof
         if self.health is not None:
             snap["health"] = self.health.state()
+        if self.ledger is not None:
+            snap["ledger"] = self.ledger.state()
         # flight-path tracing extras, so dump --trace can rebuild the
         # unified timeline offline (obs/tracing_export.py)
         if self.tracer.enabled:
@@ -341,6 +347,13 @@ class JobObs:
         if self.tenancy is None:
             return None
         return self.tenancy.tenants_snapshot()
+
+    def ledger_snapshot(self) -> Optional[dict]:
+        """Live conservation-ledger view (the /ledger.json body), or
+        None when the ledger is off (the serve layer answers 404)."""
+        if self.ledger is None:
+            return None
+        return self.ledger.state()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -458,6 +471,7 @@ class _NullJobObs:
     flight_dump_path = ""
     server = None
     tenancy = None
+    ledger = None
     resources = None
     env_fingerprint = None
 
@@ -479,6 +493,9 @@ class _NullJobObs:
         pass
 
     def tenants_snapshot(self):
+        return None
+
+    def ledger_snapshot(self):
         return None
 
     def counter(self, name: str):

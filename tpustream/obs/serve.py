@@ -20,6 +20,9 @@
 * ``GET /env.json``      — the environment fingerprint (usable cores,
   cgroup quota, NUMA nodes, jax backend/devices, hostname hash;
   obs/resources.py); 404 when collection failed
+* ``GET /ledger.json``   — the conservation ledger's live edge table
+  (per-edge terms + residuals, violation latches, per-sink digest
+  anchors; obs/ledger.py); 404 when the ledger is off
 
 Everything else is 404; non-GET methods are 405. The server is pure
 stdlib (no deps), started/stopped by ``execute_job`` alongside the
@@ -123,6 +126,17 @@ class MetricsServer:
                         404,
                         "application/json",
                         b'{"error": "no tenancy attached (single-job run)"}',
+                    )
+                body = json.dumps(view, default=str).encode("utf-8")
+                return 200, "application/json", body
+            if path == "/ledger.json":
+                ledger = getattr(self._provider, "ledger_snapshot", None)
+                view = ledger() if ledger is not None else None
+                if view is None:
+                    return (
+                        404,
+                        "application/json",
+                        b'{"error": "no ledger (ledger disabled)"}',
                     )
                 body = json.dumps(view, default=str).encode("utf-8")
                 return 200, "application/json", body

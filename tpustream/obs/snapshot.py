@@ -93,6 +93,10 @@ class Snapshotter:
         # without paying on the batch path. Exceptions are swallowed —
         # a broken refresher must never abort a snapshot.
         self.pre_hooks: List = []
+        # optional ConservationLedger: refresh() rides pre_hooks (so
+        # residual gauges are current in this snapshot's series) and
+        # every take() embeds the edge/anchor table as snap["ledger"]
+        self.ledger = None
         self.closed = False
 
     @property
@@ -150,6 +154,8 @@ class Snapshotter:
             snap["health"] = self.health_engine.evaluate(
                 snap["metrics"].get("series", []), now_s=at_s
             )
+        if self.ledger is not None:
+            snap["ledger"] = self.ledger.state()
         self.snapshots.append(snap)
         if len(self.snapshots) > self.max_snapshots:
             del self.snapshots[0 : len(self.snapshots) - self.max_snapshots]

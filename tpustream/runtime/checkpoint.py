@@ -169,6 +169,14 @@ class Checkpoint:
     # tests assert against it. Optional key — older snapshots load as
     # None, no format bump.
     ingest: Optional[dict] = None
+    # conservation ledger (obs/ledger.py): per-sink output anchors at
+    # snapshot time — {name: {count, digest, verifiable}}. A supervised
+    # restore re-derives each verifiable sink's digest over the
+    # truncated contents and flags mismatch
+    # (ledger_restore_digest_mismatch); restore REPLAY never consumes
+    # this — output bytes are still governed by sink_counts truncation.
+    # Optional key — older snapshots load as None, no format bump.
+    ledger: Optional[dict] = None
 
     def restore_chain(self, programs):
         """Restore a runner CHAIN's states: the snapshot's leaf list is
@@ -335,6 +343,7 @@ def save_checkpoint(
     rule_version: int = 0,
     tenancy: Optional[dict] = None,
     ingest: Optional[dict] = None,
+    ledger: Optional[dict] = None,
 ) -> str:
     """Snapshot to ``directory/ckpt-<source_pos>.npz`` (atomic
     write-to-.tmp + ``os.replace``); prunes to the ``keep`` newest
@@ -368,6 +377,7 @@ def save_checkpoint(
         "rule_version": int(rule_version),
         "tenancy": tenancy,
         "ingest": ingest,
+        "ledger": ledger,
         "checksum": _checksum(leaves),
     }
     arrays = {f"L{i:04d}": l for i, l in enumerate(leaves)}
@@ -523,4 +533,5 @@ def load_checkpoint(path: str) -> Checkpoint:
         rule_version=meta.get("rule_version", 0),
         tenancy=meta.get("tenancy"),
         ingest=meta.get("ingest"),
+        ledger=meta.get("ledger"),
     )

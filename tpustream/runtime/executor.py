@@ -34,6 +34,7 @@ from .sinks import (
     CollectSink,
     EmissionFormatter,
     FnSink,
+    LedgerSink,
     PrintSink,
     RetryingSink,
 )
@@ -52,6 +53,12 @@ class HostStage:
         self._raw_eval = None       # combined [ts?]+outputs native parser
         self._raw_eval_built = False
         self._raw_has_ts = False
+        # conservation-ledger source terms (obs/ledger.py): when the
+        # executor arms this dict, process() commits its filter-drop /
+        # flat_map fan counts here ON SUCCESS (an aborted parse commits
+        # nothing, so quarantine reprocessing can't double-count);
+        # account_source drains it per batch. None = ledger off.
+        self.ledger_counts: Optional[dict] = None
         if plan.ts_expr is not None:
             self._ts_eval = PlanEvaluator([plan.ts_expr], [None])
 
@@ -148,6 +155,13 @@ class HostStage:
                 wm = w.timestamp if wm is None else max(wm, w.timestamp)
         return wm
 
+    def _ledger_commit(self, dropped: int, fm_in: int, fm_out: int) -> None:
+        c = self.ledger_counts
+        if c is not None:
+            c["dropped"] += dropped
+            c["fm_in"] += fm_in
+            c["fm_out"] += fm_out
+
     def process(self, lines: List[str], proc_ts: np.ndarray):
         """Returns (Batch, wm_hint) — Batch is None for empty input."""
         plan = self.plan
@@ -156,21 +170,28 @@ class HostStage:
         ts = self._timestamps(lines)
         wm_hint = self._punctuated_wm(lines, ts) if ts is not None else None
 
+        # ledger source-edge deltas, committed only on a successful
+        # return — a parse exception after a filter/flat_map must not
+        # count those ops twice when quarantine reprocesses the batch
+        l_dropped = l_fm_in = l_fm_out = 0
         cols: Optional[List[np.ndarray]] = None
         for i, hop in enumerate(plan.host_ops):
             if hop.op == "filter":
                 fn = as_callable(hop.fn, "filter")
                 keep = [bool(fn(l)) for l in lines]
                 lines = [l for l, k in zip(lines, keep) if k]
+                l_dropped += len(keep) - len(lines)
                 sel = np.asarray(keep, dtype=bool)
                 proc_ts = proc_ts[sel]
                 if ts is not None:
                     ts = ts[sel]
                 if not lines:
+                    self._ledger_commit(l_dropped, l_fm_in, l_fm_out)
                     return None, wm_hint
                 continue
             if hop.op == "flat_map":
                 fn = as_callable(hop.fn, "flat_map")
+                l_fm_in += len(lines)
                 new_lines, new_proc, new_ts = [], [], []
                 for j, l in enumerate(lines):
                     outs = list(fn(l))
@@ -179,9 +200,11 @@ class HostStage:
                     if ts is not None:
                         new_ts.extend([ts[j]] * len(outs))
                 lines = new_lines
+                l_fm_out += len(lines)
                 proc_ts = np.asarray(new_proc, dtype=np.int64)
                 ts = np.asarray(new_ts, dtype=np.int64) if ts is not None else None
                 if not lines:
+                    self._ledger_commit(l_dropped, l_fm_in, l_fm_out)
                     return None, wm_hint
                 continue
             # map: symbolic fast path or per-record fallback
@@ -218,6 +241,7 @@ class HostStage:
             Column(k, c, t)
             for k, c, t in zip(plan.record_kinds, cols, plan.tables)
         ]
+        self._ledger_commit(l_dropped, l_fm_in, l_fm_out)
         return (
             Batch(len(lines), columns, ts=ts, proc_ts=proc_ts),
             wm_hint,
@@ -395,6 +419,22 @@ def _make_sinks(plan: JobPlan, cfg: StreamConfig):
     for so in plan.side_outputs:
         side[so.tag.id] = (_bind_ops(so.ops), build_sink(so.sink_node))
     return sinks, side
+
+
+def _ledger_contents(sink):
+    """(contents_fn, persistent) for a sink's conservation-ledger
+    account (obs/ledger.py). ``contents_fn`` exposes the retained row
+    list a digest can be re-derived from; ``persistent`` marks
+    env-owned contents that outlive a restart attempt — only those are
+    verified against restored checkpoint anchors (a PrintSink's line
+    buffer is rebuilt empty each attempt)."""
+    if isinstance(sink, RetryingSink):
+        sink = sink.inner
+    if isinstance(sink, CollectSink):
+        return (lambda s=sink: s.handle.items), True
+    if isinstance(sink, PrintSink):
+        return (lambda s=sink: s.lines), False
+    return None, False
 
 
 class Runner:
@@ -605,12 +645,30 @@ class Runner:
                         cfg.exchange_capacity_factor,
                     )
                 )
+            # every sink counts under TWO spellings kept in lockstep:
+            # the legacy flat names (operator_sink{i}_emitted /
+            # operator_side_sink{tag}_emitted, dashboards pin these)
+            # and one uniform labeled family
+            # operator_sink_emitted{sink="0"|"side:<tag>"} so ledger
+            # edges and dashboards address main and side sinks alike
+            from ..obs.registry import TwinCounter
+
             for i, (_, sink) in enumerate(self.sinks):
-                sink.obs_counter = self.obs.counter(f"sink{i}_emitted")
+                sink.obs_counter = TwinCounter(
+                    self.obs.counter(f"sink{i}_emitted"),
+                    self.obs.scoped(sink=str(i)).counter(
+                        "operator_sink_emitted"
+                    ),
+                )
                 if isinstance(sink, RetryingSink):
                     sink.retry_counter = self.obs.counter(f"sink{i}_retries")
             for tag, (_, sink) in self.side_sinks.items():
-                sink.obs_counter = self.obs.counter(f"side_sink{tag}_emitted")
+                sink.obs_counter = TwinCounter(
+                    self.obs.counter(f"side_sink{tag}_emitted"),
+                    self.obs.scoped(sink=f"side:{tag}").counter(
+                        "operator_sink_emitted"
+                    ),
+                )
                 if isinstance(sink, RetryingSink):
                     sink.retry_counter = self.obs.counter(
                         f"side_sink{tag}_retries"
@@ -627,6 +685,37 @@ class Runner:
         # label the round-robin stamper actually emits (bounded upstream
         # to top-K + "__other__" by the JobServer)
         self._tenant_e2e: Dict[str, object] = {}
+        # conservation ledger (obs/ledger.py): every sink gets a digest
+        # account + an emit-edge invariant (in == emitted + filtered),
+        # and chained hand-offs count handed/received rows. The wrap
+        # happens AFTER the obs wiring above so the RetryingSink
+        # isinstance checks saw the raw sink; LedgerSink folds a row
+        # only after every retry resolved.
+        self._ledger = getattr(metrics.job_obs, "ledger", None)
+        self._ledger_handed = 0    # rows appended to the chain hand-off
+        self._ledger_received = 0  # rows fed to THIS runner by upstream
+        self._ledger_edges: Optional[list] = None
+        self._ledger_side: Optional[dict] = None
+        if self._ledger is not None:
+            led = self._ledger
+            edges = []
+            for i in range(len(self.sinks)):
+                ops, sink = self.sinks[i]
+                contents_fn, persistent = _ledger_contents(sink)
+                acct = led.register_sink(f"sink{i}", contents_fn, persistent)
+                self.sinks[i] = (ops, LedgerSink(sink, acct))
+                edges.append(led.emit_edge(acct.name))
+            self._ledger_edges = edges
+            side = {}
+            for tag in list(self.side_sinks):
+                ops, sink = self.side_sinks[tag]
+                contents_fn, persistent = _ledger_contents(sink)
+                acct = led.register_sink(
+                    f"side:{tag}", contents_fn, persistent
+                )
+                self.side_sinks[tag] = (ops, LedgerSink(sink, acct))
+                side[tag] = led.emit_edge(acct.name)
+            self._ledger_side = side
         # flight breadcrumb: one per program compile (no-op when obs off)
         self._flight.record(
             "program_built",
@@ -1505,6 +1594,19 @@ class Runner:
     def chain_to(self, downstream: "Runner"):
         self.downstream = downstream
         downstream.count_input = False
+        if self._ledger is not None:
+            # conservation on the hand-off: rows this runner handed ==
+            # rows the downstream received + rows still parked in the
+            # hand-off buffers (closures — the evaluator reads live)
+            self._ledger.register_chain_edge(
+                "chain:"
+                + (downstream.obs.name or downstream.program.operator_name),
+                lambda u=self, d=downstream: (
+                    u._ledger_handed,
+                    d._ledger_received,
+                    u._ledger_buffered(),
+                ),
+            )
 
     def chain(self) -> List["Runner"]:
         out, r = [], self
@@ -1512,6 +1614,17 @@ class Runner:
             out.append(r)
             r = r.downstream
         return out
+
+    def _ledger_buffered(self) -> int:
+        """Rows handed to the chain but not yet pumped downstream: the
+        buffered term of the chain conservation edge. Single-host entry
+        shapes only — the ledger is forced off under multi-host."""
+        n = len(self._chain_rows)
+        for entry in self._chain_buf:
+            if entry and not isinstance(entry[0], str):
+                cols = entry[0]
+                n += len(cols[0]) if cols else 0
+        return n
 
     @staticmethod
     def _downstream_is_event_time(d: "Runner") -> bool:
@@ -1743,6 +1856,11 @@ class Runner:
                 else proc_now - 1
             )
             d.feed(batch, wl)
+            if self._ledger is not None:
+                # downstream side of the chain conservation edge:
+                # counted here (upstream pump) so feed() itself stays
+                # ledger-agnostic for source-fed runners
+                d._ledger_received += n
             d._last_tick = proc_now
             fed = True
         if (
@@ -2050,11 +2168,26 @@ class Runner:
                 else (self._dispatch_seq,) + tuple(order)
             )
             self._chain_rows.append((row, ts, o))
+            self._ledger_handed += 1
             return
-        for ops, sink in self.sinks:
+        if self._ledger_edges is None:
+            for ops, sink in self.sinks:
+                item, keep = _apply_ops(ops, row)
+                if keep:
+                    sink.emit(item, subtask=subtask)
+            return
+        # ledger on: account the per-branch fan-out (in == emitted +
+        # filtered). "in" counts after the emit resolved, so a fatally
+        # raising sink (the attempt is abandoned and replayed) does not
+        # latch a false violation — real row loss shows up on the
+        # contents/digest edges, which survive into the next attempt.
+        for (ops, sink), edge in zip(self.sinks, self._ledger_edges):
             item, keep = _apply_ops(ops, row)
             if keep:
                 sink.emit(item, subtask=subtask)
+            else:
+                edge["filtered"] += 1
+            edge["in"] += 1
 
     def _stream_rows(self, stream):
         """Resolve one fetched emission stream to its emitted rows:
@@ -2182,6 +2315,7 @@ class Runner:
                         else:
                             ts_rows = take(main["ts"])
                     self._chain_buf.append((cols, ts_rows))
+                    self._ledger_handed += int(sel.size)
                 else:
                     subtask = main.get("subtask")
                     subtask = (
@@ -2221,10 +2355,18 @@ class Runner:
         for tag_id, (ops, sink) in self.side_sinks.items():
             if tt is not None and tag_id == tt.id:
                 continue
+            edge = (
+                self._ledger_side.get(tag_id)
+                if self._ledger_side is not None else None
+            )
             for row in fmt.rows(cols):
                 item, keep = _apply_ops(ops, row)
                 if keep:
                     sink.emit(item)
+                elif edge is not None:
+                    edge["filtered"] += 1
+                if edge is not None:
+                    edge["in"] += 1
 
     def _dispatch_timeout(self, timeout):
         """Route within()-expired partial matches to the pattern's
@@ -2241,10 +2383,18 @@ class Runner:
             self.program.timeout_kinds, self.program.timeout_tables
         )
         ops, sink = entry
+        edge = (
+            self._ledger_side.get(tt.id)
+            if self._ledger_side is not None else None
+        )
         for row in fmt.rows(cols):
             item, keep = _apply_ops(ops, row)
             if keep:
                 sink.emit(item)
+            elif edge is not None:
+                edge["filtered"] += 1
+            if edge is not None:
+                edge["in"] += 1
 
 
 def _reject_count_ts(st):
@@ -2449,6 +2599,16 @@ def execute_job(env, sink_nodes) -> JobResult:
             from .supervisor import _install_lane_contention_health_rule
 
             _install_lane_contention_health_rule(env)
+    # conservation ledger (obs/ledger.py): a latched invariant violation
+    # is a correctness event, so the built-in rule is CRIT — installed
+    # here (before JobObs reads health_rules) for supervised and plain
+    # runs alike
+    from ..obs.ledger import ledger_effective
+
+    if ledger_effective(env.config.obs):
+        from .supervisor import _install_ledger_health_rule
+
+        _install_ledger_health_rule(env)
     if getattr(env.config, "restart_strategy", None) is not None:
         from .supervisor import supervise
 
@@ -2588,6 +2748,44 @@ def _execute_job(env, sink_nodes) -> JobResult:
     dead_letters = getattr(env, "dead_letters", None)
     if dead_letters is None and cfg.dead_letter:
         dead_letters = env.dead_letters = []
+    # conservation ledger (obs/ledger.py): per-edge record accounting +
+    # per-sink digest anchors, one per attempt alongside JobObs. Its
+    # refresh rides the snapshotter pre-hooks so residual gauges are
+    # evaluated at exactly the snapshot cadence (and once at close).
+    ledger = None
+    from ..obs.ledger import ledger_effective
+
+    if ledger_effective(cfg.obs):
+        if jax.process_count() == 1:
+            from ..obs.ledger import ConservationLedger
+
+            ledger = ConservationLedger(
+                job_obs, digests=getattr(cfg.obs, "ledger_digests", True)
+            )
+            job_obs.ledger = ledger
+            job_obs.snapshotter.ledger = ledger
+            job_obs.snapshotter.pre_hooks.append(ledger.refresh)
+            if dead_letters is not None:
+                ledger.register_dead_letters(dead_letters)
+            if cfg.ingest_lanes > 1:
+                # sharded ingestion parses in lane worker processes the
+                # parent's host-op counters can't see — the source edge
+                # degrades to informational; sink/chain/contents edges
+                # (all parent-side) stay exact
+                ledger.source_exact = False
+                ledger.source_note = (
+                    "sharded ingestion: host-side terms are partial, "
+                    "residual not evaluated"
+                )
+            else:
+                host.ledger_counts = {
+                    "dropped": 0, "fm_in": 0,
+                    "fm_out": 0, "quarantined": 0,
+                }
+        else:
+            # local counts are partial under multi-host SPMD — a ledger
+            # would report garbage residuals on every edge
+            job_obs.flight.record("ledger_disabled", reason="multiprocess")
     # seeded fault-injection hook (tpustream/testing/faults.py): the
     # injector object outlives restart attempts, so occurrence counters
     # keep counting across rebuilds
@@ -2705,6 +2903,14 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 )
                 del dead_letters[keep_dead:]
                 metrics.records_quarantined = len(dead_letters)
+            if ledger is not None:
+                # the truncated persistent sinks must now MATCH the
+                # snapshot's digest anchors: re-derive each digest over
+                # the rolled-back contents and verify (same-session
+                # anchors only — an older session's anchors describe
+                # another process's contents), then re-anchor every
+                # account so post-restore accounting starts clean
+                ledger.on_restore(ck.ledger, verify=same_session)
             # recovery accounting: batches the resumed run replays
             # (skips) to reach the snapshot, and wall time from failure
             # detection (incl. the restart delay) to restored state
@@ -2883,6 +3089,16 @@ def _execute_job(env, sink_nodes) -> JobResult:
                 if dead_letters is None or getattr(e, "fault_injection", False):
                     raise
                 batch, wm_hint = _quarantine(sb, e)
+        if ledger is not None:
+            # ONE atomic commit per batch (offered is post-resume-trim):
+            # the parse-ahead thread owns these terms and the snapshot
+            # evaluator reads under the same lock, so a refresh landing
+            # mid-batch never sees a torn offered/admitted cut
+            ledger.account_source(
+                offered=sb.n_records,
+                admitted=batch.n if batch is not None else 0,
+                host=host.ledger_counts,
+            )
         return sb, batch, wm_hint, hw
 
     def _parse(sb):
@@ -2915,23 +3131,44 @@ def _execute_job(env, sink_nodes) -> JobResult:
         good_idx: List[int] = []
         bad = 0
         first_err = None
-        for i, line in enumerate(lines):
-            try:
-                host.process([line], sb.proc_ts[i : i + 1])
-            except Exception as line_err:
-                bad += 1
-                first_err = first_err if first_err is not None else line_err
-                if len(dead_letters) < cfg.dead_letter_capacity:
-                    dead_letters.append(
-                        (line, f"{type(line_err).__name__}: {line_err}")
+        # per-line probe parses must not commit host-op ledger terms:
+        # the probe AND the final reparse of the good lines would count
+        # every filter/flat_map twice (process() commits on success)
+        saved_counts = host.ledger_counts
+        host.ledger_counts = None
+        try:
+            for i, line in enumerate(lines):
+                try:
+                    host.process([line], sb.proc_ts[i : i + 1])
+                except Exception as line_err:
+                    bad += 1
+                    first_err = (
+                        first_err if first_err is not None else line_err
                     )
-            else:
-                good.append(line)
-                good_idx.append(i)
+                    if len(dead_letters) < cfg.dead_letter_capacity:
+                        entry = (
+                            line, f"{type(line_err).__name__}: {line_err}"
+                        )
+                        if ledger is not None:
+                            # append + digest-fold atomically, so the
+                            # contents edge never sees one without the
+                            # other (this runs on the parse-ahead thread)
+                            ledger.note_dead_letter(dead_letters, entry)
+                        else:
+                            dead_letters.append(entry)
+                else:
+                    good.append(line)
+                    good_idx.append(i)
+        finally:
+            host.ledger_counts = saved_counts
         if not bad:
             # the batch failed as a whole but every line parses alone —
             # a genuine batch-level error, not poison data: escalate
             raise err
+        if saved_counts is not None:
+            # every bad line leaves the stream here — counted even past
+            # dead_letter_capacity, like records_quarantined below
+            saved_counts["quarantined"] += bad
         metrics.records_quarantined += bad
         job_obs.flight.record(
             "records_quarantined",
@@ -3237,6 +3474,14 @@ def _execute_job(env, sink_nodes) -> JobResult:
                         ingest_plane.cursor()
                         if ingest_plane is not None
                         else None
+                    ),
+                    # conservation ledger: per-sink (count, digest)
+                    # anchors at this barrier — a supervised restore
+                    # re-derives and verifies them over the truncated
+                    # sinks (obs/ledger.py). The drain above makes
+                    # these exact: all consumed batches have landed.
+                    ledger=(
+                        ledger.anchors() if ledger is not None else None
                     ),
                 )
             # snapshot cost series (docs/observability.md)

@@ -126,6 +126,7 @@ class RestartStrategies:
 RESTART_HEALTH_RULE_NAME = "job_restarted"
 LANE_RESTART_HEALTH_RULE_NAME = "ingest_lane_restarted"
 LANE_CONTENTION_HEALTH_RULE_NAME = "lane_core_contention"
+LEDGER_HEALTH_RULE_NAME = "ledger_conservation"
 
 
 class SupervisionState:
@@ -172,9 +173,10 @@ def _failure_cause(exc: BaseException) -> str:
     return getattr(exc, "point", None) or type(exc).__name__
 
 
-def _install_builtin_health_rule(env, name: str, metric: str) -> None:
-    """One built-in WARN threshold rule (``sum(metric) > 0``), skipped
-    when the user already configured a rule with this name."""
+def _install_builtin_health_rule(env, name: str, metric: str,
+                                 severity: str = "warn") -> None:
+    """One built-in threshold rule (``sum(metric) > 0``), skipped when
+    the user already configured a rule with this name."""
     cfg = env.config
     rules = tuple(cfg.obs.health_rules or ())
     for r in rules:
@@ -189,7 +191,7 @@ def _install_builtin_health_rule(env, name: str, metric: str) -> None:
         kind="threshold",
         op=">",
         value=0.0,
-        severity="warn",
+        severity=severity,
         agg="sum",
     )
     env.config = cfg.replace(obs=cfg.obs.replace(health_rules=rules + (rule,)))
@@ -222,6 +224,19 @@ def _install_lane_contention_health_rule(env) -> None:
     throughput halved, nothing alerted — into a health transition."""
     _install_builtin_health_rule(
         env, LANE_CONTENTION_HEALTH_RULE_NAME, "lane_core_contention_total"
+    )
+
+
+def _install_ledger_health_rule(env) -> None:
+    """Built-in CRIT rule for the conservation ledger (obs/ledger.py):
+    trips on the first latched invariant violation — a record lost or
+    duplicated on any accounted edge, or a restored sink whose contents
+    no longer match its checkpoint digest anchor. CRIT, not WARN: a
+    conservation breach means output correctness is no longer proven,
+    and /healthz flips to 503 so an external probe can fence the job."""
+    _install_builtin_health_rule(
+        env, LEDGER_HEALTH_RULE_NAME, "ledger_violations_total",
+        severity="crit",
     )
 
 
