@@ -556,6 +556,117 @@ def recovery_probe():
     )
 
 
+def checkpoint_overhead_probe(sizes=(("small", 64), ("large", 1024))):
+    """Phase C2: checkpoint-plane cost probe (docs/recovery.md "The
+    checkpoint plane"). The same checkpointed chapter2 job runs under
+    both plane postures — synchronous FULL snapshots (the pre-v12
+    posture) vs the default ASYNC INCREMENTAL plane — at two keyed-
+    state sizes. checkpoint_save_ms is the BARRIER-side cost in both
+    modes (capture + write sync, capture + budget-wait async), so its
+    p99 is the directly-comparable stall; bytes_delta is what actually
+    hit disk, so async/sync delta ratio is the incremental win. Both
+    legs must produce byte-identical sink output (the exactly-once
+    contract is not allowed to depend on the plane posture). Like
+    phase O this documents a cost surface, not a rate."""
+    import tempfile
+
+    from tpustream import StreamExecutionEnvironment
+    from tpustream.config import ObsConfig, StreamConfig
+    from tpustream.jobs.chapter2_max import build
+    from tpustream.runtime.sources import ReplaySource
+
+    def pick(series, name, field=None):
+        for s in series:
+            if s["name"] == name:
+                return s["value"][field] if field else s["value"]
+        return None
+
+    def run(lines, keys, async_, incremental):
+        with tempfile.TemporaryDirectory() as ckdir:
+            env = StreamExecutionEnvironment(StreamConfig(
+                batch_size=max(8, len(lines) // 8),
+                key_capacity=keys * 2,
+                checkpoint_dir=ckdir,
+                checkpoint_interval_batches=1,
+                checkpoint_async=async_,
+                checkpoint_incremental=incremental,
+                obs=ObsConfig(enabled=True),
+            ))
+            handle = build(
+                env, env.add_source(ReplaySource(lines))
+            ).collect()
+            env.execute("checkpoint-probe")
+            series = env.metrics.obs_snapshot()["metrics"]["series"]
+        return handle.items, series
+
+    def leg_stats(series):
+        return {
+            # p99 catches the worst barrier (the post-compile first cut
+            # in both legs — comparable); p50 is the steady-state stall
+            "barrier_stall_ms_p99": pick(series, "checkpoint_save_ms", "p99"),
+            "barrier_stall_ms_p50": pick(series, "checkpoint_save_ms", "p50"),
+            "capture_ms_p50": pick(series, "checkpoint_capture_ms", "p50"),
+            "write_wall_ms_p50": pick(
+                series, "checkpoint_write_wall_ms", "p50"
+            ),
+            "snapshots": pick(series, "checkpoint_bytes", "count"),
+            "bytes_state": pick(series, "checkpoint_bytes", "sum"),
+            "bytes_written": pick(series, "checkpoint_bytes_delta", "sum"),
+            "chunks_reused": pick(series, "checkpoint_chunks_reused_total"),
+        }
+
+    out = {}
+    for label, keys in sizes:
+        # every key appears twice so the second half of the run churns
+        # values but mints no new keys — the incremental plane's case
+        lines = [
+            f"15634520{j % 60:02d} 10.{(j % keys) >> 8}.{(j % keys) & 255}.9 "
+            f"cpu{j % 3} {(j * 13) % 100}.5"
+            for j in range(keys * 2)
+        ]
+        sync_items, sync_series = run(
+            lines, keys, async_=False, incremental=False
+        )
+        async_items, async_series = run(
+            lines, keys, async_=True, incremental=True
+        )
+        sync_leg, async_leg = leg_stats(sync_series), leg_stats(async_series)
+        stall_ratio = (
+            round(sync_leg["barrier_stall_ms_p99"]
+                  / async_leg["barrier_stall_ms_p99"], 2)
+            if sync_leg["barrier_stall_ms_p99"]
+            and async_leg["barrier_stall_ms_p99"] else None
+        )
+        delta_ratio = (
+            round(async_leg["bytes_written"] / sync_leg["bytes_written"], 3)
+            if async_leg["bytes_written"] and sync_leg["bytes_written"]
+            else None
+        )
+        out[label] = {
+            "keys": keys,
+            "sync_full": sync_leg,
+            "async_incremental": async_leg,
+            # barrier p99 sync/async: >1 means the async plane moved
+            # write cost off the hot path at this state size
+            "barrier_stall_ratio": stall_ratio,
+            # bytes-to-disk async/sync: <1 is the incremental win
+            "delta_bytes_ratio": delta_ratio,
+            "outputs_identical": (
+                _sink_digest(sync_items) == _sink_digest(async_items)
+            ),
+        }
+    worst = max(
+        (s["async_incremental"]["barrier_stall_ms_p99"] or 0.0)
+        for s in out.values()
+    )
+    out["barrier_stall_ms"] = round(worst, 3)
+    out["outputs_identical"] = all(
+        s["outputs_identical"] for s in out.values()
+        if isinstance(s, dict)
+    )
+    return out
+
+
 def dynamic_rules_probe():
     """Phase U: dynamic-rules propagation probe (docs/dynamic_rules.md).
     Runs the chapter-5 dynamic-threshold job with a mid-stream broadcast
@@ -2689,6 +2800,22 @@ def run_bench():
     except Exception as e:  # pragma: no cover
         log(f"phase R skipped: {e}")
 
+    # ---- Phase C2: checkpoint-plane overhead probe ----------------------
+    checkpointing = None
+    try:
+        checkpointing = checkpoint_overhead_probe()
+        for label in ("small", "large"):
+            s = checkpointing[label]
+            log(
+                f"phase C2: {label} state ({s['keys']} keys) -> barrier "
+                f"stall p99 sync/async "
+                f"{s['barrier_stall_ratio']}x, bytes-to-disk "
+                f"async/sync {s['delta_bytes_ratio']}, output identical: "
+                f"{s['outputs_identical']}"
+            )
+    except Exception as e:  # pragma: no cover
+        log(f"phase C2 skipped: {e}")
+
     # ---- Phase U: dynamic-rules propagation probe -----------------------
     dynamic_rules = None
     try:
@@ -2856,6 +2983,11 @@ def run_bench():
                     # delivers after an injected mid-stream crash
                     # (docs/recovery.md)
                     "recovery": recovery,
+                    # phase C2: the checkpoint plane's barrier stall and
+                    # bytes-to-disk under sync-full vs async-incremental
+                    # at two state sizes, with the byte-identical-output
+                    # proof (docs/recovery.md "The checkpoint plane")
+                    "checkpointing": checkpointing,
                     # phase U: what a runtime broadcast rule update
                     # costs — propagation latency and the zero-recompile
                     # proof (docs/dynamic_rules.md)

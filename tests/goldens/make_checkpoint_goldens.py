@@ -1,15 +1,21 @@
 """Regenerate the checked-in checkpoint-format golden fixtures.
 
 Runs GOLDEN_JOB (a tiny deterministic checkpointed chapter-2 rolling
-max) and keeps its final snapshot four ways:
+max) and keeps its final snapshot in both v12 forms plus a version
+ladder:
 
-* ``ckpt-fv10.npz`` — exactly as this build writes it (FORMAT_VERSION)
-* ``ckpt-fv08.npz`` / ``ckpt-fv09.npz`` — the same payload with the
+* ``ckpt-fv12.npz`` — the INLINE self-contained form, exactly as this
+  build writes it with ``checkpoint_incremental=False``
+* ``ckpt-fv12m.npz`` + ``chunks/`` — the INCREMENTAL manifest form:
+  the npz holds only ``__meta__``; every leaf lives in a content-hash
+  chunk file the manifest references (only the chunks the final
+  manifest needs are kept)
+* ``ckpt-fv08.npz`` … ``ckpt-fv11.npz`` — the inline payload with the
   meta version rewritten down (the ``_rewrite_format_version``
   technique from tests/test_recovery.py: payload and checksum stay
-  valid, ONLY the format version mismatches — simulating a snapshot
-  written by the pre-supervision / pre-dynamic-rules builds)
-* ``ckpt-fv11.npz`` — a version this build does not know yet
+  valid, ONLY the format version mismatches — simulating snapshots
+  written by older builds)
+* ``ckpt-fv13.npz`` — a version this build does not know yet
 
 tests/test_schema_audit.py asserts the state-layout auditor's verdict
 on each fixture matches what ``validate_checkpoint`` /
@@ -34,9 +40,11 @@ LINES = [
 ]
 
 
-def build_env(ckdir):
+def build_env(ckdir, incremental):
     """The golden job: chapter-2 rolling max over a replay source, one
-    snapshot per batch. Must stay byte-stable across regenerations."""
+    snapshot per batch. Must stay byte-stable across regenerations
+    (checkpoint_async=False: the barrier writes inline, so the run's
+    final snapshot is always the last batch's)."""
     from tpustream import StreamExecutionEnvironment
     from tpustream.config import StreamConfig
     from tpustream.jobs.chapter2_max import build
@@ -45,6 +53,8 @@ def build_env(ckdir):
         batch_size=2,
         checkpoint_dir=str(ckdir),
         checkpoint_interval_batches=1,
+        checkpoint_async=False,
+        checkpoint_incremental=incremental,
     ))
     build(env, env.from_collection(LINES)).collect()
     return env
@@ -64,26 +74,53 @@ def rewrite_format_version(path, version):
             json.dumps(meta).encode(), dtype=np.uint8)})
 
 
-def main():
-    from tpustream.runtime.checkpoint import FORMAT_VERSION
+def _final_snapshot(ckdir):
+    return sorted(glob.glob(os.path.join(ckdir, "ckpt-*.npz")))[-1]
 
-    assert FORMAT_VERSION == 10, (
+
+def main():
+    from tpustream.runtime.checkpoint import CHUNK_DIR, FORMAT_VERSION
+
+    assert FORMAT_VERSION == 12, (
         f"FORMAT_VERSION moved to {FORMAT_VERSION}: re-point the fixture "
         "names/versions below and update tests/test_schema_audit.py"
     )
+    # inline self-contained form + the version ladder derived from it
     d = tempfile.mkdtemp()
-    env = build_env(d)
-    env.execute("golden-checkpoint")
-    newest = sorted(glob.glob(os.path.join(d, "ckpt-*.npz")))[-1]
-    current = os.path.join(HERE, "ckpt-fv10.npz")
-    shutil.copy(newest, current)
-    for v in (8, 9, 11):
+    build_env(d, incremental=False).execute("golden-checkpoint")
+    current = os.path.join(HERE, "ckpt-fv12.npz")
+    shutil.copy(_final_snapshot(d), current)
+    for v in (8, 9, 10, 11, 13):
         p = os.path.join(HERE, f"ckpt-fv{v:02d}.npz")
         shutil.copy(current, p)
         rewrite_format_version(p, v)
+    # incremental manifest form: the same job's final snapshot plus the
+    # content-hash chunks its manifest references (and nothing else)
+    d2 = tempfile.mkdtemp()
+    build_env(d2, incremental=True).execute("golden-checkpoint")
+    manifest = _final_snapshot(d2)
+    shutil.copy(manifest, os.path.join(HERE, "ckpt-fv12m.npz"))
+    import numpy as np
+
+    from tpustream.runtime.checkpoint import _META_KEY
+
+    with np.load(manifest) as z:
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+    chunk_dst = os.path.join(HERE, CHUNK_DIR)
+    shutil.rmtree(chunk_dst, ignore_errors=True)
+    os.makedirs(chunk_dst)
+    for ref in meta["chunks"]:
+        name = f"{ref['chunk']}.npy"
+        shutil.copy(
+            os.path.join(d2, CHUNK_DIR, name),
+            os.path.join(chunk_dst, name),
+        )
     for n in sorted(os.listdir(HERE)):
+        p = os.path.join(HERE, n)
         if n.endswith(".npz"):
-            print(n, os.path.getsize(os.path.join(HERE, n)), "bytes")
+            print(n, os.path.getsize(p), "bytes")
+        elif os.path.isdir(p):
+            print(f"{n}/ ({len(os.listdir(p))} chunks)")
 
 
 if __name__ == "__main__":

@@ -637,6 +637,98 @@ def test_tsm051_clean_configurations():
     assert "TSM051" not in codes(env.analyze())
 
 
+def test_tsm052_dead_drill():
+    # drill interval set but obs off: the drill never arms
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm052-ck", checkpoint_interval_batches=1,
+        restore_drill_interval_s=5.0,
+    ))
+    f = next(f for f in env.analyze() if f.code == "TSM052")
+    assert f.severity == ERROR
+    assert "dead drill" in f.message
+    # obs on but checkpointing off: no snapshot to ever exercise
+    env = good_job(make_env(
+        restore_drill_interval_s=5.0, obs=ObsConfig(enabled=True),
+    ))
+    assert any(
+        f.code == "TSM052" and f.severity == ERROR for f in env.analyze()
+    )
+
+
+def test_tsm052_drill_faster_than_snapshots():
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm052-ck", checkpoint_interval_batches=1,
+        restore_drill_interval_s=0.5,
+        obs=ObsConfig(enabled=True, snapshot_interval_s=5.0),
+    ))
+    f = next(f for f in env.analyze() if f.code == "TSM052")
+    assert f.severity == WARN
+    assert "shorter than" in f.message
+
+
+def test_tsm052_clean_configurations():
+    # drill off: silent regardless of the rest
+    env = good_job(make_env(obs=ObsConfig(enabled=True)))
+    assert "TSM052" not in codes(env.analyze())
+    # fully armed drill at a sane cadence: silent
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm052-ck", checkpoint_interval_batches=1,
+        restore_drill_interval_s=10.0,
+        obs=ObsConfig(enabled=True, snapshot_interval_s=5.0),
+    ))
+    assert "TSM052" not in codes(env.analyze())
+
+
+def test_tsm053_stranded_savepoint_request():
+    # a savepoint request pending with no checkpoint_dir: the executor
+    # can never consume it (the request predates a config replace that
+    # dropped the directory)
+    env = good_job(make_env(checkpoint_dir="/tmp/tsm053-ck"))
+    env.savepoint("pre-rescale")
+    env.config = env.config.replace(checkpoint_dir="")
+    f = next(f for f in env.analyze() if f.code == "TSM053")
+    assert f.severity == ERROR
+    assert "pre-rescale" in f.message
+
+
+def test_tsm053_retention_below_inflight_budget():
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm053-ck", checkpoint_interval_batches=1,
+        checkpoint_keep=1, checkpoint_async_inflight=3,
+    ))
+    f = next(f for f in env.analyze() if f.code == "TSM053")
+    assert f.severity == WARN
+    assert "in-flight" in f.message
+
+
+def test_tsm053_keep_below_floor_is_visible():
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm053-ck", checkpoint_interval_batches=1,
+        checkpoint_keep=0,
+    ))
+    f = next(f for f in env.analyze() if f.code == "TSM053")
+    assert f.severity == WARN
+    assert "clamps to 1" in f.message
+
+
+def test_tsm053_clean_configurations():
+    # defaults: silent
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm053-ck", checkpoint_interval_batches=1,
+    ))
+    assert "TSM053" not in codes(env.analyze())
+    # retention covering the in-flight budget: silent
+    env = good_job(make_env(
+        checkpoint_dir="/tmp/tsm053-ck", checkpoint_interval_batches=1,
+        checkpoint_keep=4, checkpoint_async_inflight=2,
+    ))
+    assert "TSM053" not in codes(env.analyze())
+    # savepoint request with a directory to land in: silent
+    env = good_job(make_env(checkpoint_dir="/tmp/tsm053-ck"))
+    env.savepoint("ok")
+    assert "TSM053" not in codes(env.analyze())
+
+
 def test_findings_sorted_errors_first():
     # one ERROR (TSM013) + one INFO (TSM010) in a single graph
     env = make_env(async_depth=2)
@@ -844,6 +936,7 @@ def test_catalog_is_stable():
         "TSM022", "TSM023", "TSM024", "TSM025", "TSM030", "TSM031",
         "TSM032", "TSM033", "TSM034", "TSM040", "TSM041", "TSM042",
         "TSM043", "TSM044", "TSM045", "TSM046", "TSM047", "TSM051",
+        "TSM052", "TSM053",
     }
     assert expected <= set(CATALOG)
     for code, rule in CATALOG.items():

@@ -132,6 +132,30 @@ def test_ledger_overhead_probe_tiny_mode(bench):
     assert a["count"] > 0 and len(a["digest"]) == 64 and a["verifiable"]
 
 
+def test_checkpoint_overhead_probe_tiny_mode(bench):
+    """Phase C2 in tiny mode: both plane postures run at both state
+    sizes, the sink output is byte-identical across them (the plane
+    never touches results), the incremental leg reuses chunks and ships
+    fewer bytes than the sync-full leg, and the comparable top-level
+    barrier-stall scalar comes out."""
+    d = bench.checkpoint_overhead_probe(sizes=(("small", 16), ("large", 64)))
+    assert d["outputs_identical"]
+    assert d["barrier_stall_ms"] > 0
+    for label in ("small", "large"):
+        s = d[label]
+        assert s["outputs_identical"]
+        sync, inc = s["sync_full"], s["async_incremental"]
+        assert sync["snapshots"] > 0 and inc["snapshots"] > 0
+        assert sync["barrier_stall_ms_p99"] > 0
+        assert inc["barrier_stall_ms_p99"] > 0
+        # sync-full rewrites everything every snapshot; the incremental
+        # plane reuses stable chunks, so it must ship strictly less
+        assert sync["bytes_written"] == sync["bytes_state"]
+        assert inc["chunks_reused"] > 0
+        assert inc["bytes_written"] < sync["bytes_written"]
+        assert s["delta_bytes_ratio"] < 1.0
+
+
 def test_compare_smoke_same_env(bench, tmp_path):
     """Schema-2 records minted on this host compare cleanly: the env
     fingerprint matches itself, per-phase deltas come out, and the CI
@@ -145,6 +169,7 @@ def test_compare_smoke_same_env(bench, tmp_path):
         "round_detail": {
             "sync_rows_per_s": 1000.0,
             "ledger": {"overhead_pct": 2.0},
+            "checkpointing": {"barrier_stall_ms": 8.0},
         },
     }
     old = tmp_path / "old.json"
@@ -154,6 +179,7 @@ def test_compare_smoke_same_env(bench, tmp_path):
         json.dumps(dict(rec, round_detail={
             "sync_rows_per_s": 1500.0,
             "ledger": {"overhead_pct": 1.0},
+            "checkpointing": {"barrier_stall_ms": 4.0},
         }))
     )
     loaded = bench.load_bench_record(str(old))
@@ -169,5 +195,11 @@ def test_compare_smoke_same_env(bench, tmp_path):
     )
     assert any(
         d["phase"] == "ledger.overhead_pct" for d in cmp["improvements"]
+    )
+    # the checkpoint plane's barrier stall flattens in as a _ms metric,
+    # so a smaller stall is an improvement, never a regression
+    assert any(
+        d["phase"] == "checkpointing.barrier_stall_ms"
+        for d in cmp["improvements"]
     )
     assert bench.run_compare([str(old), str(new)], gate=True) == 0

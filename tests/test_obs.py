@@ -259,7 +259,7 @@ def test_dump_selftest_smoke(capsys):
     assert "FAIL" not in out
     m = re.search(r"selftest ok \((\d+) checks\)", out)
     assert m, out
-    assert int(m.group(1)) == 122
+    assert int(m.group(1)) == 134
     # the multi-tenant series checks are part of the suite
     assert "ok: prometheus carries the per-tenant labels" in out
     # ... and the sharded-ingestion lane series
@@ -287,6 +287,10 @@ def test_dump_selftest_smoke(capsys):
     assert "ok: hand-tampered sink trips the contents edge" in out
     assert "ok: forged anchor flags a restore digest mismatch" in out
     assert "ok: ledger.json round-trips the state" in out
+    # the checkpoint-plane renderer checks are part of the suite
+    assert "ok: incremental delta counts only fresh chunks" in out
+    assert "ok: chunk store separates referenced from orphaned" in out
+    assert "ok: interrupted GC mark is surfaced" in out
 
 
 # ---------------------------------------------------------------------------

@@ -104,6 +104,33 @@ def test_supervised_restart_rescales_p1_snapshot_to_p8(tmp_path):
     assert sorted(map(repr, out)) == sorted(map(repr, full[ck.emitted:]))
 
 
+def test_sharded_checkpoint_write_fault_recovery(tmp_path):
+    """Writer-thread crash mid-chunk-write on the p=8 mesh with the
+    async incremental plane (the defaults): the failure re-raises at a
+    barrier with its fault point intact, the supervisor restarts from
+    the newest VALID snapshot, and output stays byte-identical — the
+    store must end coherent (every retained manifest's chain walks)."""
+    import glob
+    import os
+
+    from tpustream.runtime.checkpoint import (
+        latest_checkpoint,
+        validate_checkpoint,
+    )
+
+    _, full = run(LINES, **SHARD_CFG)
+    inj = FaultInjector(FaultPoint("checkpoint_write", at=1))
+    _, out = run(
+        LINES, ckdir=tmp_path, strategy=fixed_delay(3, 0.0), injector=inj,
+        **SHARD_CFG,
+    )
+    assert inj.fired == 1
+    assert out == full
+    assert latest_checkpoint(str(tmp_path)) is not None
+    for p in glob.glob(os.path.join(str(tmp_path), "ckpt-*.npz")):
+        assert validate_checkpoint(p) is None, p
+
+
 def test_multi_fault_soak_converges(tmp_path):
     """Seeded probabilistic fault storm across three points + poison
     data: fixed_delay(10) rides out every crash and the final output is

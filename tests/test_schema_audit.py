@@ -10,8 +10,9 @@ Contracts pinned here:
   step programs compile during analysis (asserted by patching the one
   site that mints ``program_compiled``);
 * the auditor's verdict on the checked-in format-version golden
-  fixtures (tests/goldens/, v8/v9/v10/v11) exactly matches what
-  ``validate_checkpoint`` / ``load_checkpoint`` / a real restore do;
+  fixtures (tests/goldens/, v8–v13 plus the v12 incremental manifest
+  form) exactly matches what ``validate_checkpoint`` /
+  ``load_checkpoint`` / a real restore do;
 * the supervisor's ``latest_checkpoint(audit=...)`` hook pre-empts a
   doomed restore with the audit reason in its ``checkpoint_skipped``
   breadcrumb and a ``checkpoint_audit`` breadcrumb per audit;
@@ -356,14 +357,14 @@ def test_analyze_never_compiles(monkeypatch):
 
 def test_audit_identical_job_is_compatible(tmp_path):
     env = golden_env(tmp_path)
-    report = env.audit_checkpoint(fixture(10))
+    report = env.audit_checkpoint(fixture(12))
     assert isinstance(report, AuditReport)
     assert report.verdict == "compatible"
     assert report.findings == []
     assert report.reason is None
     # the expected tree is fully derived and matches the manifest 1:1
     assert len(report.expected.leaves) == len(report.manifest.leaves) == 4
-    assert report.expected.format_version == FORMAT_VERSION == 10
+    assert report.expected.format_version == FORMAT_VERSION == 12
 
 
 def test_audit_symbolic_shapes_name_the_key_axis(tmp_path):
@@ -376,7 +377,7 @@ def test_audit_symbolic_shapes_name_the_key_axis(tmp_path):
 def test_audit_grown_key_capacity_stays_compatible(tmp_path):
     # restore grows saved rows into the larger layout: supported path
     env = golden_env(tmp_path, key_capacity=4096)
-    report = env.audit_checkpoint(fixture(10))
+    report = env.audit_checkpoint(fixture(12))
     assert report.verdict == "compatible"
     assert report.findings  # visible, not silent
     assert set(codes(report.findings)) == {"TSM043"}
@@ -400,7 +401,7 @@ def test_audit_missing_leaves_tsm040(tmp_path):
         .key_by(0).max(1)
         .collect()
     )
-    report = env.audit_checkpoint(fixture(10))
+    report = env.audit_checkpoint(fixture(12))
     assert report.verdict == "incompatible"
     assert codes(report.findings) == ["TSM040"]
     assert report.reason.startswith("TSM040")
@@ -413,7 +414,7 @@ def test_audit_orphaned_leaves_tsm041(tmp_path):
 
     env = make_env()
     build1(env, env.from_collection([])).collect()
-    report = env.audit_checkpoint(fixture(10))
+    report = env.audit_checkpoint(fixture(12))
     assert report.verdict == "incompatible"
     assert codes(report.findings) == ["TSM041"]
     assert "orphaned" in report.reason
@@ -427,7 +428,7 @@ def test_audit_leaf_dtype_change_tsm042(tmp_path):
     from tpustream.runtime.checkpoint import _META_KEY, _checksum
 
     doctored = tmp_path / "ckpt-narrow.npz"
-    with np.load(fixture(10)) as z:
+    with np.load(fixture(12)) as z:
         arrays = {k: z[k] for k in z.files}
     arrays["L0002"] = arrays["L0002"].astype(np.float32)
     leaves = [arrays[k] for k in sorted(arrays) if k.startswith("L")]
@@ -453,7 +454,7 @@ def test_audit_parallelism_rescale_is_not_blocking(tmp_path):
     # call it incompatible (on a 1-device test host the sharded layout
     # is underivable, so the verdict may degrade to "unknown")
     env = golden_env(tmp_path, parallelism=2)
-    report = env.audit_checkpoint(fixture(10))
+    report = env.audit_checkpoint(fixture(12))
     assert report.verdict != "incompatible"
     assert "TSM047" in codes(report.findings)
     assert next(
@@ -471,7 +472,7 @@ def test_audit_unreadable_snapshot_tsm046(tmp_path):
     assert env.audit_checkpoint(str(p)).verdict == "incompatible"
 
 
-@pytest.mark.parametrize("version", [8, 9, 11])
+@pytest.mark.parametrize("version", [8, 9, 10, 11, 13])
 def test_audit_version_verdict_matches_real_restore(tmp_path, version):
     """TSM045 parity: every surface agrees a cross-version snapshot
     cannot restore — the auditor, validate_checkpoint, and the loader."""
@@ -481,7 +482,7 @@ def test_audit_version_verdict_matches_real_restore(tmp_path, version):
     assert "TSM045" in codes(report.findings)
     f = next(f for f in report.findings if f.code == "TSM045")
     assert f"v{version}" in f.message
-    if version == 11:
+    if version == 13:
         # a snapshot from the FUTURE: no migration narrative exists
         assert "future format" in f.message
     else:
@@ -500,32 +501,32 @@ def test_audit_version_verdict_matches_real_restore(tmp_path, version):
 
 
 def test_audit_compatible_verdict_matches_real_restore(tmp_path):
-    """The v10 fixture audits compatible AND actually restores: the
+    """The v12 fixture audits compatible AND actually restores: the
     job resumes from the snapshot's source position and completes."""
     env = golden_env(tmp_path)
-    assert env.audit_checkpoint(fixture(10)).verdict == "compatible"
-    assert validate_checkpoint(fixture(10)) is None
-    env.restore_from_checkpoint(fixture(10))
+    assert env.audit_checkpoint(fixture(12)).verdict == "compatible"
+    assert validate_checkpoint(fixture(12)) is None
+    env.restore_from_checkpoint(fixture(12))
     env.execute("golden-resume")  # snapshot is at end-of-source: no-op run
 
 
 def test_latest_checkpoint_skips_future_format(tmp_path):
-    # fv11 sorts newest; validation rejects it and recovery falls back
-    for v in (10, 11):
+    # fv13 sorts newest; validation rejects it and recovery falls back
+    for v in (12, 13):
         shutil.copy(fixture(v), tmp_path / os.path.basename(fixture(v)))
     ring = Ring()
     picked = latest_checkpoint(str(tmp_path), flight=ring)
-    assert picked == str(tmp_path / "ckpt-fv10.npz")
+    assert picked == str(tmp_path / "ckpt-fv12.npz")
     (skip,) = [p for k, p in ring.events if k == "checkpoint_skipped"]
-    assert skip["path"].endswith("ckpt-fv11.npz")
-    assert "format version 11" in skip["reason"]
+    assert skip["path"].endswith("ckpt-fv13.npz")
+    assert "format version 13" in skip["reason"]
 
 
 def test_supervisor_audit_hook_preempts_doomed_restore(tmp_path):
     """A checksum-valid, version-current snapshot whose leaf tree does
     not fit the current job is skipped BEFORE the restore attempt, with
     the TSM040 reason on the checkpoint_skipped breadcrumb."""
-    shutil.copy(fixture(10), tmp_path / "ckpt-fv10.npz")
+    shutil.copy(fixture(12), tmp_path / "ckpt-fv12.npz")
     from tpustream.jobs.chapter1_threshold import build as build1
 
     env = make_env()
@@ -542,12 +543,12 @@ def test_supervisor_audit_hook_preempts_doomed_restore(tmp_path):
 
 
 def test_supervisor_audit_passes_compatible_snapshot(tmp_path):
-    shutil.copy(fixture(10), tmp_path / "ckpt-fv10.npz")
+    shutil.copy(fixture(12), tmp_path / "ckpt-fv12.npz")
     env = golden_env(tmp_path / "ck")
     ring = Ring()
     audit = _layout_audit(env, env._sinks, ring)
     picked = latest_checkpoint(str(tmp_path), flight=ring, audit=audit)
-    assert picked == str(tmp_path / "ckpt-fv10.npz")
+    assert picked == str(tmp_path / "ckpt-fv12.npz")
     audits = [p for k, p in ring.events if k == "checkpoint_audit"]
     assert audits[0]["verdict"] == "compatible" and audits[0]["codes"] == []
     assert not [p for k, p in ring.events if k == "checkpoint_skipped"]
@@ -556,7 +557,7 @@ def test_supervisor_audit_passes_compatible_snapshot(tmp_path):
 def test_audit_crash_never_blocks_recovery(tmp_path, monkeypatch):
     # the restore path stays authoritative: an auditor bug lets the
     # snapshot through instead of wedging the supervisor
-    shutil.copy(fixture(10), tmp_path / "ckpt-fv10.npz")
+    shutil.copy(fixture(12), tmp_path / "ckpt-fv12.npz")
     env = golden_env(tmp_path / "ck")
     monkeypatch.setattr(
         "tpustream.analysis.state_audit.audit_checkpoint",
@@ -566,16 +567,39 @@ def test_audit_crash_never_blocks_recovery(tmp_path, monkeypatch):
     audit = _layout_audit(env, env._sinks, ring)
     assert latest_checkpoint(
         str(tmp_path), flight=ring, audit=audit
-    ) == str(tmp_path / "ckpt-fv10.npz")
+    ) == str(tmp_path / "ckpt-fv12.npz")
 
 
 def test_read_manifest_never_loads_arrays():
-    m = read_manifest(fixture(10))
-    assert m.meta["version"] == 10
+    m = read_manifest(fixture(12))
+    assert m.meta["version"] == 12
     assert [(l.dtype, l.shape) for l in m.leaves] == [
         ("int32", (1024,)), ("int32", (1024,)),
         ("float64", (1024,)), ("bool", (1024,)),
     ]
+
+
+def test_manifest_form_fixture_matches_inline(tmp_path):
+    """The v12 INCREMENTAL manifest fixture (meta-only npz + content-
+    hash chunks) audits identically to the inline form, validates its
+    whole chunk chain, and loads byte-identical leaves."""
+    import numpy as np
+
+    m = read_manifest(os.path.join(GOLDENS, "ckpt-fv12m.npz"))
+    assert m.meta["version"] == 12
+    # leaf headers come from the chunk refs, same surface as inline
+    assert [(l.dtype, l.shape) for l in m.leaves] == [
+        (l.dtype, l.shape) for l in read_manifest(fixture(12)).leaves
+    ]
+    env = golden_env(tmp_path)
+    report = env.audit_checkpoint(os.path.join(GOLDENS, "ckpt-fv12m.npz"))
+    assert report.verdict == "compatible"
+    assert validate_checkpoint(os.path.join(GOLDENS, "ckpt-fv12m.npz")) is None
+    inline = load_checkpoint(fixture(12))
+    manifest = load_checkpoint(os.path.join(GOLDENS, "ckpt-fv12m.npz"))
+    assert len(inline.leaves) == len(manifest.leaves)
+    for a, b in zip(inline.leaves, manifest.leaves):
+        np.testing.assert_array_equal(a, b)
 
 
 # ---------------------------------------------------------------------------
@@ -588,7 +612,7 @@ def test_audit_cli_compatible_with_job(tmp_path):
 
     out = io.StringIO()
     rc = audit_main(
-        [fixture(10), "--job", "tpustream.jobs.chapter2_max"], out=out
+        [fixture(12), "--job", "tpustream.jobs.chapter2_max"], out=out
     )
     assert rc == 0
     assert "compatible" in out.getvalue()

@@ -447,6 +447,33 @@ CATALOG = {
             "obs.ledger=True); for anchored digests also set "
             "checkpoint_dir + checkpoint_interval",
         ),
+        Rule(
+            "TSM052", ERROR, "restore drill configured but can never run",
+            "restore_drill_interval_s > 0 with observability off or "
+            "checkpointing off is a dead drill: the drill re-validates "
+            "the newest snapshot at the batch boundary and reports "
+            "through obs metrics/health rules, so with either leg "
+            "missing no checkpoint is ever exercised while the config "
+            "claims continuous restore verification. The WARN shape: a "
+            "drill interval shorter than the obs snapshot interval — "
+            "verdict flips between scrapes are invisible at that "
+            "cadence.",
+            "enable obs and checkpointing (checkpoint_dir + "
+            "checkpoint_interval_batches) or set "
+            "restore_drill_interval_s=0; keep the drill interval >= "
+            "obs.snapshot_interval_s",
+        ),
+        Rule(
+            "TSM053", ERROR, "checkpoint retention can strand recovery artifacts",
+            "a savepoint was requested with no checkpoint_dir (the "
+            "write has nowhere to land, savepoint() raises at the "
+            "batch boundary), or retention is configured below the "
+            "async in-flight budget — pruning can reach a snapshot the "
+            "writer has not finished anchoring, so the retained window "
+            "under-covers the in-flight cuts.",
+            "set checkpoint_dir before requesting savepoints; keep "
+            "checkpoint_keep >= checkpoint_async_inflight",
+        ),
     ]
 }
 

@@ -749,6 +749,104 @@ def check_ledger_config(ctx) -> Iterable[Finding]:
 
 
 @rule
+def check_restore_drill_config(ctx) -> Iterable[Finding]:
+    """TSM052: restore drill configured so it can never run, or so its
+    verdict is invisible.
+
+    The drill only arms when obs is on AND checkpointing writes
+    snapshots (executor gates on both): a positive
+    ``restore_drill_interval_s`` with either leg missing is a dead
+    drill — the config claims continuous restore verification but no
+    snapshot is ever exercised (ERROR). The quieter shape: a drill
+    cadence faster than the obs snapshot interval, where verdict flips
+    between scrapes never land in a snapshot (WARN).
+    """
+    cfg = ctx.cfg
+    drill = float(getattr(cfg, "restore_drill_interval_s", 0.0) or 0.0)
+    if drill <= 0:
+        return
+    obs = cfg.obs
+    ck_on = bool(cfg.checkpoint_dir) and cfg.checkpoint_interval_batches > 0
+    if not obs.enabled or not ck_on:
+        yield make_finding(
+            "TSM052", None,
+            f"restore_drill_interval_s={drill:g} with "
+            f"obs.enabled={obs.enabled} and checkpointing "
+            f"{'on' if ck_on else 'off'} "
+            f"(checkpoint_dir={cfg.checkpoint_dir!r}, "
+            f"interval={cfg.checkpoint_interval_batches}): the drill "
+            "dry-restores the newest snapshot and reports through obs "
+            "health rules, so with either leg missing it never runs "
+            "(dead drill)",
+        )
+        return
+    snap = float(getattr(obs, "snapshot_interval_s", 0.0) or 0.0)
+    if snap > 0 and drill < snap:
+        yield make_finding(
+            "TSM052", None,
+            f"restore_drill_interval_s={drill:g} is shorter than "
+            f"obs.snapshot_interval_s={snap:g}: drill verdicts can "
+            "flip and flip back between obs snapshots, so a failed "
+            "drill may never appear in a scrape (raise the drill "
+            "interval to at least the snapshot interval)",
+            severity=WARN,
+        )
+
+
+@rule
+def check_checkpoint_retention_config(ctx) -> Iterable[Finding]:
+    """TSM053: retention that can strand a recovery artifact.
+
+    A savepoint requested before ``execute()`` with no
+    ``checkpoint_dir`` has nowhere to land — the executor's savepoint
+    block never consumes the request (ERROR). Retention below the
+    async in-flight budget means pruning can outpace the writer:
+    ``checkpoint_keep`` snapshots retained while up to
+    ``checkpoint_async_inflight`` cuts are still being written leaves
+    a window where a just-landed snapshot is pruned before it was ever
+    the recovery floor (WARN). A requested ``checkpoint_keep < 1``
+    is clamped at resolve time but signals a config that meant to
+    disable retention and cannot (WARN).
+    """
+    cfg = ctx.cfg
+    pending = list(getattr(ctx.env, "_savepoint_requests", ()) or ())
+    if pending and not cfg.checkpoint_dir:
+        tags = ", ".join(repr(t) for t in pending[:4])
+        yield make_finding(
+            "TSM053", None,
+            f"{len(pending)} savepoint request(s) pending ({tags}) "
+            "with checkpoint_dir unset: the executor writes savepoints "
+            "next to the job's checkpoints, so the request can never "
+            "be consumed (set checkpoint_dir before execute())",
+        )
+    keep = int(getattr(cfg, "checkpoint_keep", 3))
+    if keep < 1:
+        yield make_finding(
+            "TSM053", None,
+            f"checkpoint_keep={keep} requested: retention clamps to 1 "
+            "at resolve time (the newest snapshot is the recovery "
+            "floor) — retention cannot be disabled, only widened",
+            severity=WARN,
+        )
+        keep = 1
+    inflight = int(getattr(cfg, "checkpoint_async_inflight", 1) or 1)
+    if (
+        bool(cfg.checkpoint_dir)
+        and getattr(cfg, "checkpoint_async", True)
+        and inflight > keep
+    ):
+        yield make_finding(
+            "TSM053", None,
+            f"checkpoint_keep={keep} < checkpoint_async_inflight="
+            f"{inflight}: with more cuts in flight than snapshots "
+            "retained, pruning can delete a snapshot the moment it "
+            "lands — raise checkpoint_keep to at least the in-flight "
+            "budget",
+            severity=WARN,
+        )
+
+
+@rule
 def check_unproduced_side_output(ctx) -> Iterable[Finding]:
     """TSM013: get_side_output(tag) where the parent never emits tag."""
     for chain in ctx.chains:

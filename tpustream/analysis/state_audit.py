@@ -252,8 +252,11 @@ def _format_version() -> int:
 
 def read_manifest(path: str) -> Manifest:
     """Read a snapshot's metadata and per-leaf npy HEADERS (dtype +
-    shape) without loading any state array. Raises on files that are
-    not tpustream snapshots (callers turn that into TSM046)."""
+    shape) without loading any state array. An incremental manifest
+    (v12+) carries its leaf headers in ``meta["chunks"]`` instead of
+    ``L%04d`` members — both forms yield the same leaf list. Raises on
+    files that are not tpustream snapshots (callers turn that into
+    TSM046)."""
     from ..runtime.checkpoint import _META_KEY
     from numpy.lib import format as npfmt
 
@@ -279,6 +282,19 @@ def read_manifest(path: str) -> Manifest:
                 ))
     if meta is None:
         raise KeyError(_META_KEY)
+    if not leaves and meta.get("chunks"):
+        # incremental manifest form (FORMAT_VERSION >= 12): the npz
+        # holds only __meta__; each leaf's dtype/shape rides its chunk
+        # reference, so the audit surface is identical without touching
+        # the chunk store at all
+        leaves = [
+            ManifestLeaf(
+                name=f"L{i:04d}",
+                dtype=np.dtype(ref["dtype"]).name,
+                shape=tuple(int(d) for d in ref["shape"]),
+            )
+            for i, ref in enumerate(meta["chunks"])
+        ]
     return Manifest(path=path, meta=meta, leaves=leaves)
 
 

@@ -33,6 +33,11 @@ class StreamExecutionEnvironment:
         # BroadcastStream per job; its RuleSet threads through every
         # program of the plan chain (tpustream/broadcast)
         self._broadcast = None
+        # savepoints (runtime/checkpoint.py save_savepoint): tags
+        # requested via savepoint(), consumed by the executor at the
+        # next batch boundary; written paths accumulate in savepoints
+        self._savepoint_requests: list = []
+        self.savepoints: list[str] = []
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -66,6 +71,23 @@ class StreamExecutionEnvironment:
 
     def restore_from_checkpoint(self, path: str) -> None:
         self._checkpoint_restore_path = path
+
+    def savepoint(self, tag: Optional[str] = None) -> None:
+        """Request a pinned, self-contained snapshot (Flink's savepoint:
+        the operator-triggered artifact for rescale/migration, distinct
+        from the periodic checkpoints retention may prune). The executor
+        writes it at the next batch boundary — requests registered
+        before ``execute()`` land after the first batch — into
+        ``config.checkpoint_dir`` as ``savepoint-<source_pos>[-<tag>]
+        .npz``; written paths accumulate in ``env.savepoints``. Restore
+        one explicitly via :meth:`restore_from_checkpoint` (savepoints
+        are never automatic recovery candidates)."""
+        if not self.config.checkpoint_dir:
+            raise RuntimeError(
+                "savepoint() needs config.checkpoint_dir — savepoints "
+                "are written next to the job's checkpoints"
+            )
+        self._savepoint_requests.append(tag)
 
     def set_restart_strategy(self, strategy) -> None:
         """Flink 1.8 parity (env.setRestartStrategy(

@@ -427,6 +427,30 @@ class StreamConfig:
     # -- misc ---------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_batches: int = 0  # 0 = disabled
+    # Retention tiers (runtime/checkpoint.py): keep the N newest
+    # snapshots; additionally every Mth snapshot (by write ordinal) is
+    # durable and survives pruning (0 = no durable tier). Savepoints
+    # (env.savepoint()) are always pinned regardless of these.
+    checkpoint_keep: int = 3
+    checkpoint_keep_every: int = 0
+    # Async snapshotting: True hands each captured cut to a single
+    # background writer thread (CheckpointPlane) so the barrier only
+    # pays capture; False writes synchronously on the hot path. The
+    # in-flight budget bounds queued cuts (a barrier arriving while the
+    # queue is full waits — counted as a stall).
+    checkpoint_async: bool = True
+    checkpoint_async_inflight: int = 1
+    # Incremental snapshots: True writes chunked manifests (per-leaf
+    # content-hashed chunk files; unchanged leaves re-use earlier
+    # chunks, so steady-state bytes scale with churn). False writes
+    # self-contained inline snapshots (the pre-v12 payload shape).
+    checkpoint_incremental: bool = True
+    # Restore drills: > 0 dry-restores the nominal newest snapshot
+    # every this-many seconds in-process (format + chunk-chain walk +
+    # layout audit + ledger anchor re-derivation) so bit-rot or a
+    # half-GC'd chain becomes a WARN/CRIT health transition before a
+    # crash needs the snapshot. 0 (default) disables drills.
+    restore_drill_interval_s: float = 0.0
     collect_metrics: bool = True
 
     extra: dict = field(default_factory=dict)
@@ -491,4 +515,40 @@ class StreamConfig:
                           "disables heartbeat stall detection",
             })
             cfg = cfg.replace(ingest_lane_stall_limit_ms=0.0)
+        if self.checkpoint_keep < 1:
+            notes.append({
+                "knob": "checkpoint_keep",
+                "requested": self.checkpoint_keep,
+                "effective": 1,
+                "reason": "checkpoint_keep must be >= 1; the newest "
+                          "snapshot is the recovery floor",
+            })
+            cfg = cfg.replace(checkpoint_keep=1)
+        if self.checkpoint_keep_every < 0:
+            notes.append({
+                "knob": "checkpoint_keep_every",
+                "requested": self.checkpoint_keep_every,
+                "effective": 0,
+                "reason": "checkpoint_keep_every must be >= 0; 0 "
+                          "disables the durable tier",
+            })
+            cfg = cfg.replace(checkpoint_keep_every=0)
+        if self.checkpoint_async_inflight < 1:
+            notes.append({
+                "knob": "checkpoint_async_inflight",
+                "requested": self.checkpoint_async_inflight,
+                "effective": 1,
+                "reason": "checkpoint_async_inflight must be >= 1; the "
+                          "writer needs at least one queue slot",
+            })
+            cfg = cfg.replace(checkpoint_async_inflight=1)
+        if self.restore_drill_interval_s < 0:
+            notes.append({
+                "knob": "restore_drill_interval_s",
+                "requested": self.restore_drill_interval_s,
+                "effective": 0.0,
+                "reason": "restore_drill_interval_s must be >= 0; 0 "
+                          "disables restore drills",
+            })
+            cfg = cfg.replace(restore_drill_interval_s=0.0)
         return cfg, notes

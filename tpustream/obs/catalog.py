@@ -57,6 +57,12 @@ KNOWN_SERIES = frozenset({
     # checkpoint / recovery
     "checkpoint_bytes", "checkpoint_save_ms", "recovery_wall_ms",
     "recovery_replay_batches", "job_restarts_total",
+    # checkpoint plane: async writer + incremental format + drills
+    "checkpoint_capture_ms", "checkpoint_write_wall_ms",
+    "checkpoint_bytes_delta", "checkpoint_chunks_reused_total",
+    "checkpoint_gc_deleted_total", "checkpoint_async_inflight",
+    "restore_drill_ms", "restore_drill_verdict",
+    "restore_drill_failures_total",
     # health / SLO engine
     "health_rule_state", "slo_budget_burn",
     # adaptive controller

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 
@@ -291,6 +292,168 @@ def render_ledger(led: dict) -> str:
         out.append(
             f"  restore: verified={rst.get('verified', 0)} "
             f"mismatches={rst.get('mismatches', 0)}"
+        )
+    return "\n".join(out) + "\n"
+
+
+def _read_npz_meta(path: str):
+    """``(meta, member_sizes)`` of one checkpoint ``.npz`` without
+    numpy: the file is a plain zip whose ``__meta__.npy`` member is a
+    1-D uint8 array of JSON bytes, so a hand-rolled npy-header walk
+    (magic, version byte, little-endian header length) reaches the
+    payload with the stdlib alone. ``member_sizes`` maps each member
+    name (sans ``.npy``) to its uncompressed byte size — enough to
+    price inline leaf arrays without decompressing them."""
+    import struct
+    import zipfile
+
+    with zipfile.ZipFile(path) as z:
+        sizes = {
+            i.filename[:-4]: i.file_size
+            for i in z.infolist()
+            if i.filename.endswith(".npy")
+        }
+        raw = z.read("__meta__.npy")
+    if raw[:6] != b"\x93NUMPY":
+        raise ValueError(f"{path}: __meta__ is not an npy member")
+    if raw[6] == 1:
+        hlen = struct.unpack("<H", raw[8:10])[0]
+        off = 10 + hlen
+    else:
+        hlen = struct.unpack("<I", raw[8:12])[0]
+        off = 12 + hlen
+    return json.loads(raw[off:].decode("utf-8")), sizes
+
+
+#: content-hash chunk file names, the only GC candidates (checkpoint.py)
+_CHUNK_NAME = re.compile(r"^[0-9a-f]{64}\.npy$")
+
+
+def render_checkpoints(directory: str) -> str:
+    """Render a checkpoint directory's retention tree: every snapshot
+    and savepoint in ``seq`` order with its form (inline vs chunked
+    manifest), total bytes, DELTA bytes (chunks not already referenced
+    by the previous snapshot — the incremental win), and retention
+    tier (``latest`` marker, durable, savepoint pin); then the chunk
+    store's referenced/unreferenced accounting and any interrupted-GC
+    mark. Stdlib-only, read-only, tolerant of corrupt files."""
+    import os as _os
+
+    names = sorted(
+        n for n in _os.listdir(directory)
+        if (n.startswith("ckpt-") or n.startswith("savepoint-"))
+        and n.endswith(".npz")
+    )
+    if not names:
+        return f"no snapshots in {directory}\n"
+    marker = None
+    try:
+        with open(_os.path.join(directory, "latest")) as f:
+            marker = f.read().strip() or None
+    except OSError:
+        pass
+    cdir = _os.path.join(directory, "chunks")
+    store = {}
+    if _os.path.isdir(cdir):
+        for n in _os.listdir(cdir):
+            if _CHUNK_NAME.match(n):
+                store[n[:-4]] = _os.path.getsize(_os.path.join(cdir, n))
+
+    rows, version = [], None
+    for n in names:
+        try:
+            meta, sizes = _read_npz_meta(_os.path.join(directory, n))
+        except Exception as e:
+            rows.append({"name": n, "error": f"{type(e).__name__}: {e}"})
+            continue
+        refs = meta.get("chunks")
+        if refs is not None:
+            total = sum(int(r.get("nbytes", 0)) for r in refs)
+            chunks = [str(r.get("chunk", "")) for r in refs]
+            form = "manifest"
+            missing = sum(1 for c in chunks if c not in store)
+        else:
+            total = sum(
+                s for m, s in sizes.items() if m.startswith("L")
+            )
+            chunks, form, missing = [], "inline", 0
+        version = meta.get("version", version)
+        rows.append({
+            "name": n,
+            "seq": int(meta.get("seq", 0)),
+            "kind": meta.get("kind", "checkpoint"),
+            "tag": meta.get("tag"),
+            "durable": bool(meta.get("durable")),
+            "form": form,
+            "total": total,
+            "refs": [
+                (str(r.get("chunk", "")), int(r.get("nbytes", 0)))
+                for r in (refs or [])
+            ],
+            "missing": missing,
+        })
+
+    n_save = sum(1 for r in rows if r.get("kind") == "savepoint")
+    out = [
+        f"checkpoints: {directory}  format=v{version or '?'}  "
+        f"snapshots={len(rows) - n_save}  savepoints={n_save}  "
+        f"marker={marker or '-'}"
+    ]
+    wide = max(len(r["name"]) for r in rows)
+    out.append(
+        f"  {'NAME':<{wide}} {'SEQ':>4} {'FORM':<8} "
+        f"{'BYTES':>10} {'DELTA':>10}  TIER"
+    )
+    prev_chunks = set()
+    for r in sorted(
+        [r for r in rows if "error" not in r],
+        key=lambda r: (r["seq"], r["name"]),
+    ):
+        if r["form"] == "manifest":
+            delta = sum(b for c, b in r["refs"] if c not in prev_chunks)
+            prev_chunks = {c for c, _ in r["refs"]}
+        else:
+            # an inline snapshot carries everything itself; it neither
+            # reuses nor publishes chunks, so the delta baseline holds
+            delta = r["total"]
+        tiers = []
+        if r["name"] == marker:
+            tiers.append("latest")
+        if r["kind"] == "savepoint":
+            tiers.append(
+                f"savepoint({r['tag']})" if r.get("tag") else "savepoint"
+            )
+            tiers.append("pinned")
+        elif r["durable"]:
+            tiers.append("durable")
+        line = (
+            f"  {r['name']:<{wide}} {r['seq']:>4} {r['form']:<8} "
+            f"{r['total']:>10} {delta:>10}  {','.join(tiers) or '-'}"
+        )
+        if r["missing"]:
+            line += f"  MISSING-CHUNKS:{r['missing']}"
+        out.append(line)
+    for r in rows:
+        if "error" in r:
+            out.append(f"  {r['name']:<{wide}} unreadable: {r['error']}")
+
+    if store:
+        referenced = set()
+        for r in rows:
+            referenced.update(c for c, _ in r.get("refs", []))
+        orphan = sorted(set(store) - referenced)
+        out.append(
+            f"  chunks: {len(store)} files / "
+            f"{sum(store.values())} bytes, "
+            f"referenced={len(store) - len(orphan)}, "
+            f"unreferenced={len(orphan)}"
+            + (f" ({sum(store[c] for c in orphan)} bytes)" if orphan
+               else "")
+        )
+    if _os.path.exists(_os.path.join(cdir, "gc-mark.json")):
+        out.append(
+            "  WARNING: chunks/gc-mark.json present — a GC sweep was "
+            "interrupted; the next snapshot's GC resumes it"
         )
     return "\n".join(out) + "\n"
 
@@ -969,6 +1132,117 @@ def _selftest_ledger() -> list:
     return checks
 
 
+def _selftest_checkpoints() -> list:
+    """Checkpoint-directory renderer checks: a hand-built fake
+    checkpoint plane (two chunked manifests sharing a chunk, an inline
+    tagged savepoint, a ``latest`` marker, an orphan chunk, a foreign
+    file, an interrupted-GC mark, and a corrupt ``.npz``) rendered
+    end-to-end — retention tiers, incremental delta accounting, chunk
+    store totals, and corruption tolerance, all without numpy."""
+    import os as _os
+    import struct
+    import tempfile
+    import zipfile
+
+    def npy_u8(payload: bytes) -> bytes:
+        header = (
+            "{'descr': '|u1', 'fortran_order': False, "
+            "'shape': (%d,), }" % len(payload)
+        )
+        header += " " * ((64 - (10 + len(header) + 1) % 64) % 64) + "\n"
+        return (
+            b"\x93NUMPY\x01\x00" + struct.pack("<H", len(header))
+            + header.encode("latin1") + payload
+        )
+
+    def write_npz(path, meta, leaves=()):
+        with zipfile.ZipFile(path, "w") as z:
+            for i, payload in enumerate(leaves):
+                z.writestr(f"L{i:04d}.npy", npy_u8(payload))
+            z.writestr(
+                "__meta__.npy", npy_u8(json.dumps(meta).encode("utf-8"))
+            )
+
+    ha, hb, hc, hd = "a" * 64, "b" * 64, "c" * 64, "d" * 64
+
+    def ref(h, nbytes):
+        return {"chunk": h, "dtype": "uint8", "shape": [nbytes],
+                "nbytes": nbytes}
+
+    with tempfile.TemporaryDirectory() as d:
+        cdir = _os.path.join(d, "chunks")
+        _os.makedirs(cdir)
+        for h, size in ((ha, 100), (hb, 200), (hc, 50), (hd, 64)):
+            with open(_os.path.join(cdir, h + ".npy"), "wb") as f:
+                f.write(b"\x00" * size)
+        with open(_os.path.join(cdir, "notes.txt"), "w") as f:
+            f.write("not a chunk\n")
+        with open(_os.path.join(cdir, "gc-mark.json"), "w") as f:
+            json.dump({"doomed": [hd + ".npy"]}, f)
+        base = {"version": 12, "kind": "checkpoint", "durable": False}
+        write_npz(
+            _os.path.join(d, "ckpt-0000000002.npz"),
+            dict(base, seq=1, source_pos=2,
+                 chunks=[ref(ha, 100), ref(hb, 200)]),
+        )
+        write_npz(
+            _os.path.join(d, "ckpt-0000000004.npz"),
+            dict(base, seq=2, source_pos=4, durable=True,
+                 chunks=[ref(ha, 100), ref(hc, 50)]),
+        )
+        write_npz(
+            _os.path.join(d, "savepoint-0000000004-pre.npz"),
+            dict(base, seq=3, source_pos=4, durable=True,
+                 kind="savepoint", tag="pre"),
+            leaves=(b"\x07" * 80,),
+        )
+        with open(_os.path.join(d, "ckpt-0000000009.npz"), "wb") as f:
+            f.write(b"this is not a zip archive")
+        with open(_os.path.join(d, "latest"), "w") as f:
+            f.write("ckpt-0000000004.npz")
+        try:
+            text = render_checkpoints(d)
+            raised = None
+        except Exception as e:  # the tolerance check below fails loudly
+            text, raised = "", e
+        lines = {
+            l.split()[0]: l for l in text.splitlines() if l.strip()
+        }
+        empty = render_checkpoints(cdir)  # no snapshots live there
+
+    first = lines.get("ckpt-0000000002.npz", "")
+    second = lines.get("ckpt-0000000004.npz", "")
+    save = lines.get("savepoint-0000000004-pre.npz", "")
+    return [
+        ("checkpoint render survives a corrupt member", raised is None),
+        ("checkpoint header counts forms and names the marker",
+         "snapshots=3" in text and "savepoints=1" in text
+         and "marker=ckpt-0000000004.npz" in text
+         and "format=v12" in text),
+        ("manifest bytes priced from chunk refs",
+         " 300 " in first and "manifest" in first),
+        ("incremental delta counts only fresh chunks",
+         second.split()[4] == "50" and first.split()[4] == "300"),
+        ("latest marker tier rides the marked snapshot",
+         "latest" in second and "latest" not in first),
+        ("durable tier annotated", "durable" in second
+         and "durable" not in first),
+        ("savepoint is pinned and carries its tag",
+         "savepoint(pre)" in save and "pinned" in save
+         and "inline" in save),
+        ("inline snapshot priced from its leaf members",
+         save.split()[3] == save.split()[4] != "0"),
+        ("chunk store separates referenced from orphaned",
+         "chunks: 4 files" in text and "referenced=3" in text
+         and "unreferenced=1 (64 bytes)" in text),
+        ("interrupted GC mark is surfaced", "gc-mark.json present" in text),
+        ("corrupt snapshot degrades to an unreadable row",
+         "unreadable:" in lines.get("ckpt-0000000009.npz", "")),
+        ("empty directory renders the no-snapshots notice",
+         empty.startswith("no snapshots in ")),
+    ]
+
+
 def _selftest() -> int:
     """CI smoke mode: a canned registry (hostile labels included) runs
     through snapshot -> render -> Prometheus exposition -> health
@@ -1321,6 +1595,7 @@ def _selftest() -> int:
     checks.extend(_selftest_trace())
     checks.extend(_selftest_resources())
     checks.extend(_selftest_ledger())
+    checks.extend(_selftest_checkpoints())
     failed = [name for name, ok in checks if not ok]
     for name, ok in checks:
         sys.stdout.write(f"{'ok' if ok else 'FAIL'}: {name}\n")
@@ -1383,6 +1658,13 @@ def main(argv=None) -> int:
         "residuals, violation latches, per-sink digest anchors)",
     )
     ap.add_argument(
+        "--checkpoints",
+        action="store_true",
+        help="treat PATH as a checkpoint DIRECTORY and render its "
+        "retention tree (per-snapshot form/bytes/delta/tier, chunk "
+        "store accounting, interrupted-GC marks)",
+    )
+    ap.add_argument(
         "--rules",
         help="JSON file with a list of alert-rule dicts to (re-)evaluate "
         "against the snapshot's series",
@@ -1411,6 +1693,10 @@ def main(argv=None) -> int:
         return 0
     if not args.path:
         ap.error("path is required (or use --selftest / --env)")
+    if args.checkpoints:
+        out = render_checkpoints(args.path)
+        sys.stdout.write(out)
+        return 1 if out.startswith("no snapshots in ") else 0
     snap = _load(args.path, args.index)
     if args.env:
         env = snap.get("meta", {}).get("env") or snap.get("env")

@@ -389,6 +389,41 @@ class ConservationLedger:
 
     # -- restore verification -----------------------------------------------
 
+    def verify_anchors(self, saved: Optional[dict]) -> Optional[str]:
+        """Restore-drill hook (runtime/checkpoint.py restore_drill):
+        re-derive each verifiable sink's digest over the FIRST
+        ``count`` rows of its current contents — the snapshot anchored
+        a prefix of a still-running sink — and compare to the saved
+        anchor. Pure read: no reseed, no gauges, no latched violation
+        (the drill surfaces failures through its own metric/breadcrumb).
+        Returns None when every checkable anchor matches, else a
+        reason string."""
+        if not saved or not self.digests:
+            return None
+        for name, a in sorted(saved.items()):
+            acct = self.accounts.get(name)
+            if (
+                acct is None or not acct.verifiable
+                or not a.get("verifiable") or a.get("digest") is None
+            ):
+                continue
+            contents = list(acct.contents_fn())
+            n = int(a.get("count", 0))
+            if n > len(contents):
+                return (
+                    f"sink {name} anchored {n} rows but now holds "
+                    f"{len(contents)} — output shrank past the snapshot"
+                )
+            h = hashlib.sha256()
+            for v in contents[:n]:
+                h.update(encode_row(v))
+            if h.hexdigest() != a["digest"]:
+                return (
+                    f"sink {name} digest over the anchored {n}-row prefix "
+                    "no longer matches the snapshot anchor"
+                )
+        return None
+
     def on_restore(self, saved: Optional[dict], verify: bool = True) -> None:
         """After a supervised restore truncated the persistent sinks
         back to the snapshot: re-derive each verifiable sink's digest

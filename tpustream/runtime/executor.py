@@ -2609,6 +2609,19 @@ def execute_job(env, sink_nodes) -> JobResult:
         from .supervisor import _install_ledger_health_rule
 
         _install_ledger_health_rule(env)
+    # restore drills (runtime/checkpoint.py restore_drill): a failed
+    # dry-restore of the nominal newest snapshot is a WARN, repeated
+    # failures CRIT — installed here so the rules exist before JobObs
+    # reads health_rules, supervised and plain runs alike
+    if (
+        env.config.restore_drill_interval_s > 0
+        and env.config.obs.enabled
+        and bool(env.config.checkpoint_dir)
+        and env.config.checkpoint_interval_batches > 0
+    ):
+        from .supervisor import _install_restore_drill_health_rules
+
+        _install_restore_drill_health_rules(env)
     if getattr(env.config, "restart_strategy", None) is not None:
         from .supervisor import supervise
 
@@ -2642,6 +2655,14 @@ def _run_attempt(env, sink_nodes) -> JobResult:
         plane = env.__dict__.pop("_ingest_plane", None)
         if plane is not None:
             plane.close()
+        # checkpoint-plane clean-up: join the writer thread (an
+        # in-flight write may land — completed snapshots are always
+        # consistent); a writer failure is NOT re-raised here — either
+        # it already crossed at a submit/flush, or the attempt is
+        # failing for its own reason, which stays the reported cause
+        ck_plane = env.__dict__.pop("_checkpoint_plane", None)
+        if ck_plane is not None:
+            ck_plane.close(raise_error=False)
     job_obs = getattr(env.metrics, "job_obs", None)
     if job_obs is not None:
         job_obs.close()
@@ -3020,6 +3041,209 @@ def _execute_job(env, sink_nodes) -> JobResult:
 
     ckpt_every = cfg.checkpoint_interval_batches
     ckpt_enabled = bool(cfg.checkpoint_dir) and ckpt_every > 0
+    # async checkpoint plane (runtime/checkpoint.py CheckpointPlane):
+    # the barrier pays capture only; encode + write + prune + GC run on
+    # one background writer thread. Coordinator-only — non-coordinator
+    # processes still capture (the gather is collective) and drop the
+    # cut, matching the sync path's early return.
+    is_coordinator = jax.process_index() == 0
+    ckpt_plane = None
+    if ckpt_enabled and cfg.checkpoint_async and is_coordinator:
+        from .checkpoint import CheckpointPlane
+
+        ckpt_plane = CheckpointPlane(
+            cfg.checkpoint_dir,
+            keep=cfg.checkpoint_keep,
+            keep_every=cfg.checkpoint_keep_every,
+            inflight=cfg.checkpoint_async_inflight,
+            incremental=cfg.checkpoint_incremental,
+            fault=fault,
+        )
+        # _run_attempt's finally pops and closes this, so a crashed
+        # attempt never leaks a writer thread (and an in-flight write
+        # is allowed to land — completed snapshots are consistent)
+        env._checkpoint_plane = ckpt_plane
+
+    def _note_checkpoint_report(rep: dict) -> None:
+        """One completed write's report -> the metrics/flight surface.
+        Main-thread only: async reports cross over via drain_reports."""
+        if "write_wall_ms" in rep:
+            job_obs.histogram("checkpoint_write_wall_ms").observe(
+                rep["write_wall_ms"]
+            )
+        job_obs.histogram("checkpoint_bytes").observe(rep["bytes_total"])
+        job_obs.histogram("checkpoint_bytes_delta").observe(
+            rep["bytes_delta"]
+        )
+        job_obs.counter("checkpoint_chunks_reused_total").inc(
+            rep["chunks_reused"]
+        )
+        if rep["gc_deleted"]:
+            job_obs.counter("checkpoint_gc_deleted_total").inc(
+                rep["gc_deleted"]
+            )
+        job_obs.flight.record(
+            "checkpoint_saved",
+            path=rep["path"],
+            batches=rep["batches"],
+            source_pos=rep["source_pos"],
+            write_ms=round(rep.get("write_wall_ms", 0.0), 3),
+            bytes_delta=rep["bytes_delta"],
+            chunks_reused=rep["chunks_reused"],
+            # environment stamp (obs/resources.py): a restored run
+            # can prove what host/backend wrote the snapshot
+            env=job_obs.env_compact(),
+        )
+
+    def _capture_cut():
+        """One consistent cut at the checkpoint barrier. Emissions
+        still in flight belong to pre-snapshot batches — a resume
+        replays only post-snapshot lines — so they flush down the whole
+        chain first; sink counts and ledger anchors are then exact as
+        of this cut (not of write completion)."""
+        from .checkpoint import capture_checkpoint
+
+        runner.drain_chain(proc_now)
+        stages = runner.chain()
+        emitted = metrics.records_emitted
+        if jax.process_count() > 1:
+            # each process emits only its shards' records; the
+            # snapshot records the GLOBAL count (the capture is
+            # already a collective, so this gather aligns)
+            from jax.experimental import multihost_utils as mh
+
+            emitted = int(
+                mh.process_allgather(
+                    np.asarray([emitted], np.int64)
+                ).sum()
+            )
+        lazy_schemas = [
+            {
+                "kinds": list(r.plan.record_kinds),
+                "tables": [
+                    t.state_dict() if t is not None else None
+                    for t in r.plan.tables
+                ],
+            }
+            for r in stages
+            if getattr(r, "_lazy_schema", False)
+        ]
+        return capture_checkpoint(
+            lazy_schemas=lazy_schemas,
+            key_capacities=[r.cfg.key_capacity for r in stages],
+            # only non-lazy CHAIN stages need this: stage 0's
+            # derived table rides meta["tables"], lazy stages'
+            # ride lazy_schemas
+            chain_key_tables=[
+                r.plan.tables[-1].state_dict()
+                if si > 0
+                and r.plan.synthetic_key
+                and not getattr(r, "_lazy_schema", False)
+                and r.plan.tables
+                else None
+                for si, r in enumerate(stages)
+            ],
+            state=(
+                [r.state for r in stages]
+                if len(stages) > 1
+                else runner.state
+            ),
+            plan=plan,
+            source_pos=lines_consumed,
+            proc_now=proc_now,
+            emitted=emitted,
+            batches=metrics.batches,
+            job_name=env.job_name,
+            parallelism=max(1, cfg.parallelism),
+            # supervised-recovery metadata: collect-sink lengths
+            # at the snapshot (output rollback on restore),
+            # quarantine high-water mark, and the supervision
+            # session nonce that scopes both
+            sink_counts=[
+                len(n.params["handle"].items)
+                for n in sink_nodes
+                if n.op == "sink_collect"
+            ],
+            quarantined=(
+                len(dead_letters) if dead_letters is not None else 0
+            ),
+            session=(
+                supervision.nonce if supervision is not None else None
+            ),
+            # dynamic rules: the host RuleSet's values + applied-
+            # update count at the snapshot — restore re-syncs the
+            # control-feed cursor from these (broadcast/rules.py)
+            rule_values=(
+                ruleset.values() if ruleset is not None else None
+            ),
+            rule_version=(
+                ruleset.version if ruleset is not None else 0
+            ),
+            # multi-tenancy: the JobServer's host fleet state
+            # (tenant->slot map, admitted/quota counters); the
+            # per-tenant rule vectors ride rule_values above
+            tenancy=(
+                env._tenancy.state_dict()
+                if getattr(env, "_tenancy", None) is not None
+                else None
+            ),
+            # sharded ingestion: the per-lane frame cursor at
+            # this snapshot (frames the merge consumed; frames
+            # still in a lane ring are not in source_pos either,
+            # so recovery replays them exactly once)
+            ingest=(
+                ingest_plane.cursor()
+                if ingest_plane is not None
+                else None
+            ),
+            # conservation ledger: per-sink (count, digest)
+            # anchors at this barrier — a supervised restore
+            # re-derives and verifies them over the truncated
+            # sinks (obs/ledger.py). The drain above makes
+            # these exact: all consumed batches have landed.
+            ledger=(
+                ledger.anchors() if ledger is not None else None
+            ),
+        )
+
+    # restore drills (runtime/checkpoint.py restore_drill): time-gated
+    # dry restore of the nominal newest snapshot — format + chunk-chain
+    # walk, layout audit, ledger anchor re-derivation — so bit-rot is a
+    # health transition before a crash needs the snapshot
+    drill_interval = cfg.restore_drill_interval_s
+    drill_last = [time.monotonic()]
+
+    def _maybe_restore_drill() -> None:
+        if (
+            drill_interval <= 0
+            or not ckpt_enabled
+            or not is_coordinator
+            or time.monotonic() - drill_last[0] < drill_interval
+        ):
+            return
+        drill_last[0] = time.monotonic()
+        from .checkpoint import restore_drill
+        from .supervisor import _layout_audit
+
+        with Stopwatch() as dr_sw:
+            res = restore_drill(
+                cfg.checkpoint_dir,
+                audit=_layout_audit(env, sink_nodes, job_obs.flight),
+                verify_anchors=(
+                    ledger.verify_anchors if ledger is not None else None
+                ),
+            )
+        if res["ok"] is None:
+            return  # nothing to drill yet
+        job_obs.histogram("restore_drill_ms").observe(dr_sw.elapsed * 1000.0)
+        job_obs.gauge("restore_drill_verdict").set(1.0 if res["ok"] else 0.0)
+        if not res["ok"]:
+            job_obs.counter("restore_drill_failures_total").inc()
+            job_obs.flight.record(
+                "restore_drill_failed",
+                path=res["path"],
+                reason=res["reason"],
+            )
     # Emission pipelining helps only when batches arrive back to back; a
     # PACED source (steady-rate feed with idle gaps) would otherwise see
     # its results parked in the in-flight window for async_depth batch
@@ -3375,136 +3599,77 @@ def _execute_job(env, sink_nodes) -> JobResult:
             and runner is not None
             and metrics.batches % ckpt_every == 0
         ):
-            from .checkpoint import save_checkpoint
-
-            # emissions still in flight belong to pre-snapshot batches;
-            # a resume replays only post-snapshot lines, so flush them
-            # down the whole chain before the states are captured
-            runner.drain_chain(proc_now)
-            stages = runner.chain()
-            emitted = metrics.records_emitted
-            if jax.process_count() > 1:
-                # each process emits only its shards' records; the
-                # snapshot records the GLOBAL count (the save is
-                # already a collective, so this gather aligns)
-                from jax.experimental import multihost_utils as mh
-
-                emitted = int(
-                    mh.process_allgather(
-                        np.asarray([emitted], np.int64)
-                    ).sum()
-                )
-            lazy_schemas = [
-                {
-                    "kinds": list(r.plan.record_kinds),
-                    "tables": [
-                        t.state_dict() if t is not None else None
-                        for t in r.plan.tables
-                    ],
-                }
-                for r in stages
-                if getattr(r, "_lazy_schema", False)
-            ]
             with Stopwatch() as ck_sw:
-                ck_path = save_checkpoint(
-                    cfg.checkpoint_dir,
-                    lazy_schemas=lazy_schemas,
-                    key_capacities=[r.cfg.key_capacity for r in stages],
-                    # only non-lazy CHAIN stages need this: stage 0's
-                    # derived table rides meta["tables"], lazy stages'
-                    # ride lazy_schemas
-                    chain_key_tables=[
-                        r.plan.tables[-1].state_dict()
-                        if si > 0
-                        and r.plan.synthetic_key
-                        and not getattr(r, "_lazy_schema", False)
-                        and r.plan.tables
-                        else None
-                        for si, r in enumerate(stages)
-                    ],
-                    state=(
-                        [r.state for r in stages]
-                        if len(stages) > 1
-                        else runner.state
-                    ),
-                    plan=plan,
-                    source_pos=lines_consumed,
-                    proc_now=proc_now,
-                    emitted=emitted,
-                    batches=metrics.batches,
-                    job_name=env.job_name,
-                    parallelism=max(1, cfg.parallelism),
-                    # supervised-recovery metadata: collect-sink lengths
-                    # at the snapshot (output rollback on restore),
-                    # quarantine high-water mark, and the supervision
-                    # session nonce that scopes both
-                    sink_counts=[
-                        len(n.params["handle"].items)
-                        for n in sink_nodes
-                        if n.op == "sink_collect"
-                    ],
-                    quarantined=(
-                        len(dead_letters) if dead_letters is not None else 0
-                    ),
-                    session=(
-                        supervision.nonce if supervision is not None else None
-                    ),
-                    # dynamic rules: the host RuleSet's values + applied-
-                    # update count at the snapshot — restore re-syncs the
-                    # control-feed cursor from these (broadcast/rules.py)
-                    rule_values=(
-                        ruleset.values() if ruleset is not None else None
-                    ),
-                    rule_version=(
-                        ruleset.version if ruleset is not None else 0
-                    ),
-                    # multi-tenancy: the JobServer's host fleet state
-                    # (tenant->slot map, admitted/quota counters); the
-                    # per-tenant rule vectors ride rule_values above
-                    tenancy=(
-                        env._tenancy.state_dict()
-                        if getattr(env, "_tenancy", None) is not None
-                        else None
-                    ),
-                    # sharded ingestion: the per-lane frame cursor at
-                    # this snapshot (frames the merge consumed; frames
-                    # still in a lane ring are not in source_pos either,
-                    # so recovery replays them exactly once)
-                    ingest=(
-                        ingest_plane.cursor()
-                        if ingest_plane is not None
-                        else None
-                    ),
-                    # conservation ledger: per-sink (count, digest)
-                    # anchors at this barrier — a supervised restore
-                    # re-derives and verifies them over the truncated
-                    # sinks (obs/ledger.py). The drain above makes
-                    # these exact: all consumed batches have landed.
-                    ledger=(
-                        ledger.anchors() if ledger is not None else None
-                    ),
-                )
-            # snapshot cost series (docs/observability.md)
+                with Stopwatch() as cap_sw:
+                    pending = _capture_cut()
+                if ckpt_plane is not None:
+                    # hand the cut to the writer thread; a full queue
+                    # makes this wait (the counted barrier stall), and
+                    # a writer failure re-raises HERE with its original
+                    # fault point intact
+                    ckpt_plane.submit(pending)
+                    job_obs.gauge("checkpoint_async_inflight").set(
+                        float(ckpt_plane.inflight())
+                    )
+                elif is_coordinator:
+                    from .checkpoint import write_snapshot
+
+                    with Stopwatch() as wr_sw:
+                        rep = write_snapshot(
+                            cfg.checkpoint_dir,
+                            pending,
+                            keep=cfg.checkpoint_keep,
+                            keep_every=cfg.checkpoint_keep_every,
+                            incremental=cfg.checkpoint_incremental,
+                            fault=fault,
+                        )
+                    rep["write_wall_ms"] = wr_sw.elapsed * 1000.0
+                    _note_checkpoint_report(rep)
+            # snapshot cost series (docs/observability.md):
+            # checkpoint_save_ms is the BARRIER-side total — capture +
+            # budget wait in async mode, capture + write in sync mode —
+            # so async vs sync stall is directly comparable;
+            # checkpoint_capture_ms isolates the capture itself
+            job_obs.histogram("checkpoint_capture_ms").observe(
+                cap_sw.elapsed * 1000.0
+            )
             job_obs.histogram("checkpoint_save_ms").observe(
                 ck_sw.elapsed * 1000.0
             )
-            if ck_path:
-                try:
-                    job_obs.histogram("checkpoint_bytes").observe(
-                        os.path.getsize(ck_path)
-                    )
-                except OSError:
-                    pass
-            job_obs.flight.record(
-                "checkpoint_saved",
-                path=ck_path,
-                batches=metrics.batches,
-                source_pos=lines_consumed,
-                save_ms=round(ck_sw.elapsed * 1000.0, 3),
-                # environment stamp (obs/resources.py): a restored run
-                # can prove what host/backend wrote the snapshot
-                env=job_obs.env_compact(),
-            )
+        if ckpt_plane is not None:
+            reports = ckpt_plane.drain_reports()
+            if reports:
+                for rep in reports:
+                    _note_checkpoint_report(rep)
+                job_obs.gauge("checkpoint_async_inflight").set(
+                    float(ckpt_plane.inflight())
+                )
+        if (
+            getattr(env, "_savepoint_requests", None)
+            and runner is not None
+            and cfg.checkpoint_dir
+        ):
+            # pinned self-contained snapshots on request (rescale /
+            # migration artifacts) — written synchronously at the batch
+            # boundary, exempt from retention and GC by name
+            from .checkpoint import save_savepoint
+
+            sp_requests = list(env._savepoint_requests)
+            env._savepoint_requests.clear()
+            sp_pending = _capture_cut()
+            for sp_tag in sp_requests:
+                sp_path = save_savepoint(
+                    cfg.checkpoint_dir, sp_pending, tag=sp_tag
+                )
+                env.savepoints.append(sp_path)
+                job_obs.flight.record(
+                    "savepoint_written",
+                    path=sp_path,
+                    tag=sp_tag,
+                    source_pos=lines_consumed,
+                    batches=metrics.batches,
+                )
+        _maybe_restore_drill()
         t_iter_done = time.perf_counter()
         if sb.final:
             break
@@ -3550,5 +3715,14 @@ def _execute_job(env, sink_nodes) -> JobResult:
             r.finalize_metrics()
             r.check_strict()
             r = r.downstream
+
+    if ckpt_plane is not None:
+        # land every queued write before the job returns, and surface a
+        # writer failure even when no later barrier submitted (a fault
+        # with EOS right behind it must still fail the attempt)
+        ckpt_plane.flush()
+        for rep in ckpt_plane.drain_reports():
+            _note_checkpoint_report(rep)
+        job_obs.gauge("checkpoint_async_inflight").set(0.0)
 
     return JobResult(metrics)

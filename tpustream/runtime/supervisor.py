@@ -127,6 +127,8 @@ RESTART_HEALTH_RULE_NAME = "job_restarted"
 LANE_RESTART_HEALTH_RULE_NAME = "ingest_lane_restarted"
 LANE_CONTENTION_HEALTH_RULE_NAME = "lane_core_contention"
 LEDGER_HEALTH_RULE_NAME = "ledger_conservation"
+DRILL_WARN_HEALTH_RULE_NAME = "restore_drill_failed"
+DRILL_CRIT_HEALTH_RULE_NAME = "restore_drill_failing"
 
 
 class SupervisionState:
@@ -174,9 +176,10 @@ def _failure_cause(exc: BaseException) -> str:
 
 
 def _install_builtin_health_rule(env, name: str, metric: str,
-                                 severity: str = "warn") -> None:
-    """One built-in threshold rule (``sum(metric) > 0``), skipped when
-    the user already configured a rule with this name."""
+                                 severity: str = "warn",
+                                 value: float = 0.0) -> None:
+    """One built-in threshold rule (``sum(metric) > value``), skipped
+    when the user already configured a rule with this name."""
     cfg = env.config
     rules = tuple(cfg.obs.health_rules or ())
     for r in rules:
@@ -190,7 +193,7 @@ def _install_builtin_health_rule(env, name: str, metric: str,
         metric=metric,
         kind="threshold",
         op=">",
-        value=0.0,
+        value=value,
         severity=severity,
         agg="sum",
     )
@@ -237,6 +240,21 @@ def _install_ledger_health_rule(env) -> None:
     _install_builtin_health_rule(
         env, LEDGER_HEALTH_RULE_NAME, "ledger_violations_total",
         severity="crit",
+    )
+
+
+def _install_restore_drill_health_rules(env) -> None:
+    """Built-in WARN→CRIT pair for restore drills (runtime/checkpoint.py
+    restore_drill): WARN on the first failed drill — the snapshot a
+    crash would want first did not verify — and CRIT once drills fail
+    repeatedly (> 1), the sustained-bit-rot shape where recovery from
+    the nominal newest snapshot can be presumed broken."""
+    _install_builtin_health_rule(
+        env, DRILL_WARN_HEALTH_RULE_NAME, "restore_drill_failures_total"
+    )
+    _install_builtin_health_rule(
+        env, DRILL_CRIT_HEALTH_RULE_NAME, "restore_drill_failures_total",
+        severity="crit", value=1.0,
     )
 
 

@@ -1,6 +1,6 @@
 """Seeded fault injection for supervised-execution tests and bench.
 
-The runtime exposes ten control-plane fault points, checked on the
+The runtime exposes twelve control-plane fault points, checked on the
 paths named after them:
 
 * ``source_read``  — before each source batch enters the host stage
@@ -28,6 +28,18 @@ paths named after them:
   updates): targets crash recovery of the multi-tenant fleet mid
   admission or rule change (see tpustream/tenancy and
   docs/multitenancy.md)
+* ``checkpoint_write`` — inside the snapshot writer, mid-chunk-write
+  (after the first chunk lands, before the manifest): models the
+  writer thread dying with orphan chunks on disk and no manifest —
+  the ``latest`` marker still names the previous snapshot, recovery
+  restores from it, and the next GC collects the orphans. In async
+  mode the failure crosses back to the stepping thread at the next
+  submit/flush with its ``point`` intact (runtime/checkpoint.py
+  CheckpointPlane)
+* ``checkpoint_gc`` — between the GC mark file landing and the unlink
+  sweep: models a crash that leaves ``chunks/gc-mark.json`` plus the
+  still-undeleted chunks; the next GC re-verifies the marked names
+  against the live reference set and finishes the sweep
 
 Two further points target the sharded ingest plane's LANE WORKER
 PROCESSES (runtime/ingest.py lane supervision) and are evaluated inside
@@ -79,6 +91,8 @@ FAULT_POINTS = (
     "sink_emit",
     "control_apply",
     "tenant_apply",
+    "checkpoint_write",
+    "checkpoint_gc",
     "lane_worker_crash",
     "lane_worker_hang",
 )
